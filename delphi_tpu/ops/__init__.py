@@ -1,0 +1,1 @@
+"""Jitted statistical kernels over dictionary-encoded tables."""

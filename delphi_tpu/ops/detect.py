@@ -1,0 +1,299 @@
+"""Error-detection kernels over encoded tables.
+
+Replaces the reference's per-detector SQL scans (`ErrorDetectorApi.scala:
+128-300`) with vectorized mask / group operations:
+
+* NULL scan: one mask over the code tensor.
+* RegEx scan: the regex is evaluated once per distinct vocab entry (not per
+  row), then broadcast through the dictionary codes — a major win over the
+  reference's per-row RLIKE.
+* Gaussian (IQR) outliers: percentile bounds + mask.
+* Denial-constraint violations: instead of a SQL self-join with an EXISTS
+  subquery per constraint (ErrorDetectorApi.scala:213-231), rows are grouped
+  by the EQ-predicate key and the remaining predicate is answered with
+  group-level statistics (distinct counts / extrema) — O(n log n), not O(n²).
+
+All detectors return row-index arrays per attribute; the Python wrappers in
+:mod:`delphi_tpu.errors` shape them into (row_id, attribute) frames.
+"""
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from delphi_tpu.constraints import AttrRef, Constant, DenialConstraints, Predicate
+from delphi_tpu.table import EncodedTable, NULL_CODE
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+CellIndex = Tuple[np.ndarray, str]  # (row indices, attribute)
+
+
+def detect_null_cells(table: EncodedTable, target_attrs: Sequence[str]) \
+        -> List[CellIndex]:
+    out = []
+    for name in table.column_names:
+        if name in target_attrs:
+            rows = np.nonzero(table.column(name).null_mask())[0]
+            if rows.size:
+                out.append((rows, name))
+    return out
+
+
+def detect_regex_errors(table: EncodedTable, attr: str, regex: str,
+                        target_attrs: Sequence[str]) -> List[CellIndex]:
+    """Cells whose string value does NOT contain a match of ``regex`` (RLIKE
+    partial-match semantics, ErrorDetectorApi.scala:174-186), plus NULLs."""
+    if attr not in target_attrs or not regex or not regex.strip():
+        return []
+    try:
+        compiled = re.compile(regex)
+    except re.error:
+        _logger.warning(f"Invalid regex found: {regex}")
+        return []
+    col = table.column(attr)
+    # Evaluate on the vocab (distinct values), then broadcast through codes.
+    vocab_ok = np.array([compiled.search(str(v)) is not None for v in col.vocab],
+                        dtype=bool)
+    ok = np.zeros(table.n_rows, dtype=bool)
+    valid = col.codes != NULL_CODE
+    ok[valid] = vocab_ok[col.codes[valid]]
+    rows = np.nonzero(~ok)[0]  # non-matching values OR NULLs
+    return [(rows, attr)] if rows.size else []
+
+
+def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
+                    target_attrs: Sequence[str]) -> List[CellIndex]:
+    """Box-and-whisker outliers per continuous attribute
+    (ErrorDetectorApi.scala:249-300): flag values outside
+    [q1 - 1.5*IQR, q3 + 1.5*IQR]."""
+    out = []
+    attrs = [a for a in continuous_attrs if a in target_attrs]
+    for attr in attrs:
+        col = table.column(attr)
+        assert col.numeric is not None
+        values = col.numeric
+        valid = ~np.isnan(values)
+        if not valid.any():
+            continue
+        q1, q3 = np.percentile(values[valid], [25.0, 75.0])
+        lower = q1 - 1.5 * (q3 - q1)
+        upper = q3 + 1.5 * (q3 - q1)
+        _logger.info(f"Non-outlier values in {attr} should be in [{lower}, {upper}]")
+        bad = valid & ((values < lower) | (values > upper))
+        rows = np.nonzero(bad)[0]
+        if rows.size:
+            out.append((rows, attr))
+    return out
+
+
+def _shared_codes(table: EncodedTable, left: str, right: str) \
+        -> Tuple[np.ndarray, np.ndarray]:
+    """Codes for two columns in a shared dictionary so cross-attribute
+    equality can compare codes directly. NULL stays -1."""
+    cl, cr = table.column(left), table.column(right)
+    if left == right:
+        return cl.codes, cr.codes
+    vocab = {}
+    for v in cl.vocab:
+        vocab.setdefault(v, len(vocab))
+    for v in cr.vocab:
+        vocab.setdefault(v, len(vocab))
+    map_l = np.array([vocab[v] for v in cl.vocab], dtype=np.int64)
+    map_r = np.array([vocab[v] for v in cr.vocab], dtype=np.int64)
+
+    def remap(codes: np.ndarray, m: np.ndarray) -> np.ndarray:
+        out = np.full(codes.shape, NULL_CODE, dtype=np.int64)
+        valid = codes != NULL_CODE
+        out[valid] = m[codes[valid]]
+        return out
+
+    return remap(cl.codes, map_l), remap(cr.codes, map_r)
+
+
+def _comparable_values(table: EncodedTable, attr: str) -> np.ndarray:
+    """Values under SQL comparison semantics: numeric columns compare
+    numerically (NaN for NULL), string columns lexicographically."""
+    col = table.column(attr)
+    if col.is_numeric:
+        assert col.numeric is not None
+        return col.numeric
+    # Lexicographic: map each value to its rank in the sorted vocab.
+    order = np.argsort(col.vocab.astype(str), kind="stable")
+    rank = np.empty(len(col.vocab), dtype=np.float64)
+    rank[order] = np.arange(len(col.vocab), dtype=np.float64)
+    out = np.full(table.n_rows, np.nan)
+    valid = col.codes != NULL_CODE
+    out[valid] = rank[col.codes[valid]]
+    return out
+
+
+def _one_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
+        -> np.ndarray:
+    """Rows satisfying ALL constant predicates (the EXISTS collapses to a
+    per-row filter for one-tuple constraints)."""
+    mask = np.ones(table.n_rows, dtype=bool)
+    for p in preds:
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, Constant)
+        col = table.column(p.left.name)
+        value_strings = np.array(
+            [str(v) for v in col.vocab], dtype=object)
+        literal = p.right.literal
+        vocab_match = value_strings == literal
+        m = np.zeros(table.n_rows, dtype=bool)
+        valid = col.codes != NULL_CODE
+        m[valid] = vocab_match[col.codes[valid]]
+        if p.sign == "EQ":
+            mask &= m
+        elif p.sign == "IQ":
+            mask &= ~m  # null-safe: NULL <=> const is false, so NOT(...) true
+        else:
+            # LT/GT against constants: compare on string values like Spark
+            # would after implicit casts; numeric columns compare numerically.
+            if col.is_numeric:
+                try:
+                    lit_v = float(literal)
+                except ValueError:
+                    return np.zeros(table.n_rows, dtype=bool)
+                assert col.numeric is not None
+                with np.errstate(invalid="ignore"):
+                    cmp = col.numeric < lit_v if p.sign == "LT" else col.numeric > lit_v
+                cmp = np.where(np.isnan(col.numeric), False, cmp)
+            else:
+                vals = col.decode()
+                cmp = np.array(
+                    [(v is not None) and ((v < literal) if p.sign == "LT" else (v > literal))
+                     for v in vals], dtype=bool)
+            mask &= cmp
+    return mask
+
+
+def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
+        -> np.ndarray:
+    """Left-tuple rows r1 with some r2 satisfying the conjunction.
+
+    EQ predicates form the join key; the remaining predicates are answered
+    with per-group statistics when there is at most one of them, falling back
+    to in-group pairwise evaluation otherwise.
+    """
+    eq = [p for p in preds if p.sign == "EQ" and p.is_cross_tuple]
+    rest = [p for p in preds if not (p.sign == "EQ" and p.is_cross_tuple)]
+    n = table.n_rows
+
+    # Join keys: left rows keyed by left-attr codes, right rows by right-attr
+    # codes, in shared dictionaries (null-safe: NULL code is a key value).
+    if eq:
+        k1_cols, k2_cols = [], []
+        for p in eq:
+            assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+            c1, c2 = _shared_codes(table, p.left.name, p.right.name)
+            k1_cols.append(c1)
+            k2_cols.append(c2)
+        k1 = np.stack(k1_cols, axis=1)
+        k2 = np.stack(k2_cols, axis=1)
+        both = np.concatenate([k1, k2], axis=0)
+        _, inv = np.unique(both, axis=0, return_inverse=True)
+        g1, g2 = inv[:n], inv[n:]
+        n_groups = int(inv.max()) + 1 if inv.size else 0
+    else:
+        g1 = g2 = np.zeros(n, dtype=np.int64)
+        n_groups = 1 if n else 0
+
+    if not rest:
+        # Violation iff the right-side group is non-empty (self matches).
+        group_count = np.bincount(g2, minlength=n_groups)
+        return group_count[g1] > 0
+
+    if len(rest) == 1:
+        p = rest[0]
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+        if p.sign == "IQ":
+            a1, a2 = _shared_codes(table, p.left.name, p.right.name)
+            # r1 violates iff its group holds a right-value different from
+            # r1's left-value (null-safe inequality counts NULL vs value).
+            pairs = np.unique(np.stack([g2, a2], axis=1), axis=0)
+            distinct = np.bincount(pairs[:, 0], minlength=n_groups)
+            single = np.zeros(n_groups, dtype=np.int64)
+            single[pairs[:, 0]] = pairs[:, 1]  # only read where distinct == 1
+            d1 = distinct[g1]
+            return (d1 >= 2) | ((d1 == 1) & (single[g1] != a1))
+        if p.sign in ("LT", "GT"):
+            v1 = _comparable_values(table, p.left.name)
+            v2 = _comparable_values(table, p.right.name)
+            # r1 violates iff r1.left < max(group right) (LT) / > min (GT);
+            # NULLs never satisfy an order comparison.
+            valid2 = ~np.isnan(v2)
+            init = -np.inf if p.sign == "LT" else np.inf
+            ext = np.full(n_groups, init)
+            if p.sign == "LT":
+                np.maximum.at(ext, g2[valid2], v2[valid2])
+            else:
+                np.minimum.at(ext, g2[valid2], v2[valid2])
+            bound = ext[g1]
+            with np.errstate(invalid="ignore"):
+                cmp = v1 < bound if p.sign == "LT" else v1 > bound
+            return np.where(np.isnan(v1) | np.isinf(bound), False, cmp)
+        raise AssertionError(f"unexpected predicate sign: {p.sign}")
+
+    # General fallback: in-group pairwise evaluation of the residual
+    # conjunction (rare in practice; bounded by group sizes).
+    order2 = np.argsort(g2, kind="stable")
+    group_members: Dict[int, np.ndarray] = {}
+    start = 0
+    sg = g2[order2]
+    while start < len(sg):
+        end = start
+        while end < len(sg) and sg[end] == sg[start]:
+            end += 1
+        group_members[int(sg[start])] = order2[start:end]
+        start = end
+
+    def pred_holds(p: Predicate, i: int, j: int) -> bool:
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+        lc = table.value_string(p.left.name, i)
+        rc = table.value_string(p.right.name, j)
+        if p.sign == "EQ":
+            return lc == rc
+        if p.sign == "IQ":
+            return lc != rc
+        lv = _comparable_values(table, p.left.name)[i]
+        rv = _comparable_values(table, p.right.name)[j]
+        if np.isnan(lv) or np.isnan(rv):
+            return False
+        return lv < rv if p.sign == "LT" else lv > rv
+
+    out = np.zeros(n, dtype=bool)
+    for i in range(n):
+        members = group_members.get(int(g1[i]), np.empty(0, dtype=np.int64))
+        for j in members:
+            if all(pred_holds(p, i, int(j)) for p in rest):
+                out[i] = True
+                break
+    return out
+
+
+def detect_constraint_violations(table: EncodedTable,
+                                 constraints: DenialConstraints,
+                                 target_attrs: Sequence[str]) -> List[CellIndex]:
+    """For each constraint, flags every referenced target attribute of every
+    violating left-tuple row (ErrorDetectorApi.scala:213-231)."""
+    out: List[CellIndex] = []
+    for preds in constraints.predicates:
+        attrs = []
+        for p in preds:
+            for r in p.references:
+                if r in target_attrs and r not in attrs:
+                    attrs.append(r)
+        if not attrs:
+            continue
+        if all(isinstance(p.right, Constant) for p in preds):
+            mask = _one_tuple_violations(table, preds)
+        else:
+            mask = _two_tuple_violations(table, preds)
+        rows = np.nonzero(mask)[0]
+        if rows.size:
+            for a in attrs:
+                out.append((rows, a))
+    return out

@@ -1,0 +1,111 @@
+"""Cell-domain computation with naive-Bayes posterior scoring.
+
+Replaces the reference's per-attribute chain of fold-joins + explode + group-by
+SQL (`RepairApi.scala:479-675`) with one vectorized kernel per target
+attribute: for the error cells of target ``a``, gather each correlated
+attribute's pair-count row, threshold by tau, convert to evidence weights
+``max(cnt - 1, 0.1)``, sum the per-correlate posteriors, normalize per cell,
+and keep values whose probability clears the beta threshold.
+
+Per the reference semantics:
+* tau = int(alpha * (n_rows // (|dom c| * |dom a|))) — note the integer
+  division quirk (RepairApi.scala:572-576).
+* each contribution is exp(ln(cnt_a(v)/N) + ln(w/cnt_a(v))) = w / N, guarded
+  on the singleton count being present (RepairApi.scala:613-646).
+* continuous targets and targets without correlates get empty domains.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from delphi_tpu.ops.freq import FreqStats
+from delphi_tpu.table import DiscretizedTable, NULL_CODE
+
+
+@dataclass
+class CellDomain:
+    row_index: int
+    attribute: str
+    current_value: Optional[str]
+    domain: List[Tuple[str, float]]  # (candidate value, posterior prob), sorted desc
+
+
+def compute_domain_in_error_cells(
+        disc: DiscretizedTable,
+        cells: Sequence[Tuple[int, str, Optional[str]]],
+        continuous_attrs: Sequence[str],
+        target_attrs: Sequence[str],
+        freq: FreqStats,
+        pairwise_stats: Dict[str, List[Tuple[str, float]]],
+        domain_stats: Dict[str, int],
+        max_attrs_to_compute_domains: int,
+        alpha: float,
+        beta: float) -> List[CellDomain]:
+    """``cells``: (row_index, attribute, current_value_string) triples.
+
+    Returns one :class:`CellDomain` per input cell whose attribute is in
+    ``target_attrs`` (same filtering as RepairApi.scala:530-531).
+    """
+    assert max_attrs_to_compute_domains > 0
+    assert 0.0 <= alpha < 1.0 and 0.0 <= beta < 1.0
+    assert alpha < beta, "domainThresholdAlpha should be less than domainThresholdBeta"
+
+    n = disc.table.n_rows
+    continuous = set(continuous_attrs)
+    table = disc.table
+
+    out: List[CellDomain] = []
+    by_attr: Dict[str, List[Tuple[int, Optional[str]]]] = {}
+    for row, attr, cur in cells:
+        if attr in target_attrs:
+            by_attr.setdefault(attr, []).append((row, cur))
+
+    for attr, attr_cells in by_attr.items():
+        rows = np.asarray([r for r, _ in attr_cells], dtype=np.int64)
+        currents = [c for _, c in attr_cells]
+
+        corr_attrs = [c for c, _ in pairwise_stats.get(attr, [])][:max_attrs_to_compute_domains]
+        corr_attrs = [c for c in corr_attrs if freq.has_pair(c, attr)]
+
+        if attr in continuous or not corr_attrs or not table.has_column(attr):
+            out.extend(CellDomain(int(r), attr, cur, [])
+                       for r, cur in zip(rows, currents))
+            continue
+
+        vocab = table.column(attr).vocab
+        v_a = len(vocab)
+        single = freq.single(attr)[1:]  # [v_a], non-NULL value counts
+        # posterior contribution accumulator per (cell, candidate value)
+        score = np.zeros((len(rows), v_a), dtype=np.float64)
+        contributed = np.zeros((len(rows), v_a), dtype=bool)
+
+        for c in corr_attrs:
+            d_c = int(domain_stats[c])
+            d_a = int(domain_stats[attr])
+            tau = int(alpha * (n // max(d_c * d_a, 1)))
+
+            pair = freq.pair(c, attr)        # [V_c + 1, V_a + 1]
+            codes_c = table.column(c).codes[rows]  # corr-attr value per cell row
+            gathered = pair[codes_c + 1][:, 1:]    # [cells, v_a]; NULL rows give slot 0
+            valid = (codes_c != NULL_CODE)[:, None]
+            active = (gathered > max(tau, 0)) & (gathered > 0) & valid
+            weights = np.where(active, np.maximum(gathered - 1.0, 0.1), 0.0)
+            # exp(ln(cnt_v/N) + ln(w/cnt_v)) == w/N, valid only when cnt_v > 0
+            has_single = single > 0
+            contrib = np.where(has_single[None, :], weights / n, 0.0)
+            score += np.where(active & has_single[None, :], contrib, 0.0)
+            contributed |= active & has_single[None, :]
+
+        denom = score.sum(axis=1, keepdims=True)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prob = np.where(denom > 0, score / denom, 0.0)
+
+        for i, (r, cur) in enumerate(zip(rows, currents)):
+            keep = np.nonzero(contributed[i] & (prob[i] > beta))[0]
+            dom = [(str(vocab[j]), float(prob[i, j])) for j in keep]
+            dom.sort(key=lambda t: (-t[1], t[0]))
+            out.append(CellDomain(int(r), attr, cur, dom))
+
+    return out

@@ -1,0 +1,114 @@
+"""Pairwise conditional-entropy correlation statistics.
+
+Replaces the reference's per-pair entropy SQL jobs (`RepairApi.scala:284-394`)
+with vectorized log2 reductions over the dense pair-count matrices, keeping
+the exact semantics:
+
+    H(x|y) = H(x,y) - H(y)
+
+where both entropies carry a missing-mass correction term: frequency groups
+that fell below the freq-ratio threshold (or were never observed) are modeled
+as `ubDomainSize` synthetic groups of average count
+`max((n - observed_total) / ubDomainSize, 1)` — see RepairApi.scala:306-325
+and 347-365. Domain sizes come from the ORIGINAL table stats (not bin counts),
+matching the reference's quirk of passing `convertToDiscretizedTable`'s
+domain_stats straight through.
+
+If H(x|y) ~ 0 then y functionally determines x, so for each target x the
+result list is sorted ascending — strongest correlate first.
+"""
+
+import math
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from delphi_tpu.ops.freq import FreqStats, Pair
+
+
+def _entropy_with_correction(counts: np.ndarray, n_rows: int, ub_domain: int) \
+        -> float:
+    """-sum (c/n) log2 (c/n) over observed groups, plus the missing-mass
+    correction for unobserved/filtered groups."""
+    observed = counts[counts > 0].astype(np.float64)
+    total = float(observed.sum())
+    p = observed / n_rows
+    h = float(-(p * np.log2(p)).sum()) if observed.size else 0.0
+
+    if n_rows > total:
+        ub = max(ub_domain - observed.size, 1)
+        avg = max((n_rows - total) / ub, 1.0)
+        h += -ub * (avg / n_rows) * math.log2(avg / n_rows)
+    return h
+
+
+def compute_pairwise_stats(
+        n_rows: int,
+        freq: FreqStats,
+        target_attr_pairs: Sequence[Pair],
+        domain_stats: Dict[str, int]) -> Dict[str, List[Tuple[str, float]]]:
+    """For each requested (x, y): H(x|y), grouped by x and sorted ascending.
+
+    Mirrors `RepairApi.computePairwiseStats` (RepairApi.scala:284-394)
+    including its worst-case behavior when no frequency stats survive.
+    """
+    if not target_attr_pairs:
+        return {}
+
+    assert n_rows > 0
+    target_attrs = list(dict.fromkeys(a for p in target_attr_pairs for a in p))
+    assert all(a in domain_stats for a in target_attrs)
+
+    # H(x,y) per unordered pair
+    h_xy: Dict[frozenset, float] = {}
+    for x, y in target_attr_pairs:
+        key = frozenset((x, y))
+        if key in h_xy:
+            continue
+        m = freq.pair(x, y)
+        h_xy[key] = _entropy_with_correction(
+            m.ravel(), n_rows, int(domain_stats[x]) * int(domain_stats[y]))
+
+    # H(y) per attr
+    h_y: Dict[str, float] = {}
+    for a in target_attrs:
+        h_y[a] = _entropy_with_correction(
+            freq.single(a), n_rows, int(domain_stats[a]))
+
+    result: Dict[str, List[Tuple[str, float]]] = {}
+    for x, y in target_attr_pairs:
+        result.setdefault(x, []).append((y, h_xy[frozenset((x, y))] - h_y[y]))
+    for x in result:
+        result[x] = sorted(result[x], key=lambda t: t[1])
+    return result
+
+
+def select_candidate_pairs(
+        freq_for_pruning,
+        attrs_to_repair: Sequence[str],
+        all_attrs: Sequence[str],
+        domain_stats: Dict[str, int],
+        pairwise_freq_ratio_threshold: float,
+        max_attrs_to_compute_pairwise_stats: int) -> List[Pair]:
+    """Candidate-pair pruning by co-occurrence distinct-count ratio
+    (RepairApi.scala:429-448): when a target has more candidates than the cap,
+    keep pairs whose #distinct(x,y) / (|x|*|y|) is below the threshold, sorted
+    ascending, truncated to the cap.
+
+    ``freq_for_pruning`` must expose ``distinct_pair_count(x, y)``.
+    """
+    out: List[Pair] = []
+    for x in attrs_to_repair:
+        candidates = [(x, y) for y in all_attrs if y != x]
+        if len(candidates) > max_attrs_to_compute_pairwise_stats:
+            scored = []
+            for (cx, cy) in candidates:
+                co = freq_for_pruning.distinct_pair_count(cx, cy)
+                ratio = co / (int(domain_stats[cx]) * int(domain_stats[cy]))
+                scored.append((ratio, (cx, cy)))
+            scored = [s for s in scored if s[0] < pairwise_freq_ratio_threshold]
+            scored.sort(key=lambda t: t[0])
+            out.extend(p for _, p in scored[:max_attrs_to_compute_pairwise_stats])
+        else:
+            out.extend(candidates)
+    return out

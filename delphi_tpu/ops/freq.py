@@ -1,0 +1,192 @@
+"""Frequency statistics over encoded tables.
+
+TPU-native replacement for the reference's single GROUPING-SETS aggregation
+(`RepairApi.scala:231-273`): instead of SQL groups with `grouping()` indicator
+columns, we compute
+
+* singleton value counts per attribute, and
+* pair co-occurrence count matrices per candidate attribute pair
+
+as ONE batched, padded ``bincount`` over fused integer keys, jitted so XLA
+lowers it to dense one-hot matmuls / scatter-adds on the TPU. NULL is a
+first-class value (slot 0), matching SQL GROUP BY semantics where NULL forms
+its own group.
+
+Unlike the reference, there is no 64-attribute limit: pairs are batched, not
+packed into a single grouping-set bitmap.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from delphi_tpu.table import EncodedTable
+
+Pair = Tuple[str, str]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _batched_single_counts(codes: jnp.ndarray, v_pad: int) -> jnp.ndarray:
+    """codes: int32[n, m] with NULL=-1  ->  counts int32[m, v_pad+1]
+    (slot 0 counts NULLs, slot i+1 counts vocab entry i)."""
+
+    def one(col: jnp.ndarray) -> jnp.ndarray:
+        return jnp.bincount(col + 1, length=v_pad + 1)
+
+    return jax.vmap(one, in_axes=1)(codes)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _batched_pair_counts(codes: jnp.ndarray, xi: jnp.ndarray, yi: jnp.ndarray,
+                         v_pad: int) -> jnp.ndarray:
+    """Fused-key bincount: for each pair p, counts[(cx+1)*(v_pad+1) + (cy+1)]
+    over rows -> int32[n_pairs, (v_pad+1)**2]."""
+    stride = v_pad + 1
+
+    def one(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        keys = (codes[:, x] + 1) * stride + (codes[:, y] + 1)
+        return jnp.bincount(keys, length=stride * stride)
+
+    return jax.vmap(one)(xi, yi)
+
+
+@dataclass
+class FreqStats:
+    """Singleton and pairwise frequency stats for a discretized table.
+
+    Count slot 0 is the NULL group. ``threshold_count`` reproduces the
+    reference's `HAVING cnt > int(n_rows * attr_freq_ratio_threshold)` filter
+    (RepairApi.scala:255-262): filtered views zero out failing groups.
+    """
+
+    n_rows: int
+    attrs: List[str]
+    vocab_sizes: Dict[str, int]
+    singles: Dict[str, np.ndarray]              # [V_a + 1] raw counts
+    pairs: Dict[Pair, np.ndarray]               # [V_x + 1, V_y + 1] raw counts
+    threshold_count: int = 0
+
+    def _filter(self, counts: np.ndarray) -> np.ndarray:
+        if self.threshold_count <= 0:
+            return counts
+        return np.where(counts > self.threshold_count, counts, 0)
+
+    def single(self, attr: str, filtered: bool = True) -> np.ndarray:
+        c = self.singles[attr]
+        return self._filter(c) if filtered else c
+
+    def has_pair(self, x: str, y: str) -> bool:
+        return (x, y) in self.pairs or (y, x) in self.pairs
+
+    def pair(self, x: str, y: str, filtered: bool = True) -> np.ndarray:
+        """Pair count matrix oriented [V_x+1, V_y+1] regardless of the
+        stored orientation."""
+        if (x, y) in self.pairs:
+            m = self.pairs[(x, y)]
+        else:
+            m = self.pairs[(y, x)].T
+        return self._filter(m) if filtered else m
+
+    def distinct_pair_count(self, x: str, y: str) -> int:
+        """# of distinct (x, y) value pairs over all rows (NULLs included),
+        the exact version of `approx_count_distinct(struct(x, y))`
+        (RepairApi.scala:433-437)."""
+        return int(np.count_nonzero(self.pair(x, y, filtered=False)))
+
+
+def compute_freq_stats(table: EncodedTable,
+                       target_attrs: Sequence[str],
+                       pair_attrs: Sequence[Pair],
+                       attr_freq_ratio_threshold: float = 0.0) -> FreqStats:
+    """Computes singleton counts for ``target_attrs`` and pair count matrices
+    for ``pair_attrs`` in two batched jitted kernels."""
+    assert 0.0 <= attr_freq_ratio_threshold <= 1.0
+
+    attrs = list(dict.fromkeys(target_attrs))
+    # Dedup unordered pairs, keeping first-seen orientation.
+    seen = set()
+    pairs: List[Pair] = []
+    for x, y in pair_attrs:
+        key = frozenset((x, y))
+        if key not in seen:
+            seen.add(key)
+            pairs.append((x, y))
+
+    vocab_sizes = {c.name: c.domain_size for c in table.columns}
+    needed = list(dict.fromkeys(attrs + [a for p in pairs for a in p]))
+    v_pad = max((vocab_sizes[a] for a in needed), default=0)
+
+    codes = jnp.asarray(table.codes(needed))
+    name_to_idx = {a: i for i, a in enumerate(needed)}
+
+    singles_arr = np.asarray(_batched_single_counts(codes, v_pad))
+    singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
+
+    pair_mats: Dict[Pair, np.ndarray] = {}
+    if pairs:
+        xi = jnp.asarray([name_to_idx[x] for x, _ in pairs], dtype=jnp.int32)
+        yi = jnp.asarray([name_to_idx[y] for _, y in pairs], dtype=jnp.int32)
+        flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))
+        stride = v_pad + 1
+        for p, (x, y) in enumerate(pairs):
+            m = flat[p].reshape(stride, stride)
+            pair_mats[(x, y)] = m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
+
+    return FreqStats(
+        n_rows=table.n_rows,
+        attrs=attrs,
+        vocab_sizes=vocab_sizes,
+        singles=singles,
+        pairs=pair_mats,
+        threshold_count=int(table.n_rows * attr_freq_ratio_threshold),
+    )
+
+
+class PairDistinctCounter:
+    """Exact #distinct (x, y) value pairs per attribute pair, used for
+    candidate-pair pruning (`approx_count_distinct(struct(x, y))`,
+    RepairApi.scala:433-437) without materializing pair matrices."""
+
+    def __init__(self, table: EncodedTable) -> None:
+        self._table = table
+        self._cache: Dict[frozenset, int] = {}
+
+    def distinct_pair_count(self, x: str, y: str) -> int:
+        key = frozenset((x, y))
+        if key not in self._cache:
+            cx = self._table.column(x)
+            cy = self._table.column(y)
+            fused = (cx.codes.astype(np.int64) + 1) * (cy.domain_size + 1) \
+                + (cy.codes.astype(np.int64) + 1)
+            self._cache[key] = int(np.unique(fused).size)
+        return self._cache[key]
+
+
+def freq_stats_to_pandas(stats: FreqStats, table: EncodedTable):
+    """Debug/parity view shaped like the reference's freq-stat table:
+    one row per surviving group with value strings and counts."""
+    import pandas as pd
+
+    rows = []
+    for a in stats.attrs:
+        vocab = table.column(a).vocab
+        counts = stats.single(a)
+        for slot, cnt in enumerate(counts):
+            if cnt > 0:
+                value = None if slot == 0 else vocab[slot - 1]
+                rows.append({"attrs": (a,), "values": (value,), "cnt": int(cnt)})
+    for (x, y), _ in stats.pairs.items():
+        m = stats.pair(x, y)
+        vx = table.column(x).vocab
+        vy = table.column(y).vocab
+        nz = np.argwhere(m > 0)
+        for i, j in nz:
+            value_x = None if i == 0 else vx[i - 1]
+            value_y = None if j == 0 else vy[j - 1]
+            rows.append({"attrs": (x, y), "values": (value_x, value_y),
+                         "cnt": int(m[i, j])})
+    return pd.DataFrame(rows, columns=["attrs", "values", "cnt"])

@@ -1,0 +1,82 @@
+"""In-memory table catalog — the TPU-native replacement for Spark's temp-view
+registry (reference `RepairBase.scala:80-99`, `RepairUtils.scala:37-45`).
+
+Tables are pandas DataFrames registered under (optionally db-qualified) names.
+The repair pipeline looks inputs up here, registers intermediates under random
+names, and drops them in ``finally`` blocks — same lifecycle as the reference's
+temp views, without a JVM.
+"""
+
+import threading
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+
+from delphi_tpu.utils import get_random_string, setup_logger
+
+_logger = setup_logger()
+
+
+class AnalysisException(ValueError):
+    """Raised for invalid inputs (reference `ExceptionUtils.scala:20-26`)."""
+
+
+class DelphiSession:
+    """Process-wide singleton holding the table catalog and config."""
+
+    _instance: Optional["DelphiSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._catalog: Dict[str, pd.DataFrame] = {}
+        self.conf: Dict[str, str] = {}
+
+    @classmethod
+    def get_or_create(cls) -> "DelphiSession":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DelphiSession()
+            return cls._instance
+
+    # -- catalog ------------------------------------------------------------
+
+    def register(self, name: str, df: pd.DataFrame) -> str:
+        assert isinstance(df, pd.DataFrame), f"expected pandas DataFrame, got {type(df)}"
+        self._catalog[name] = df
+        return name
+
+    def register_temp(self, df: pd.DataFrame, prefix: str) -> str:
+        name = get_random_string(prefix)
+        return self.register(name, df)
+
+    def table(self, name: str) -> pd.DataFrame:
+        if name not in self._catalog:
+            raise AnalysisException(f"Table or view not found: {name}")
+        return self._catalog[name]
+
+    def exists(self, name: str) -> bool:
+        return name in self._catalog
+
+    def drop(self, name: str) -> None:
+        self._catalog.pop(name, None)
+
+    def table_names(self) -> List[str]:
+        return sorted(self._catalog)
+
+    def qualified_name(self, db_name: str, table_name: str) -> str:
+        return f"{db_name}.{table_name}" if db_name else table_name
+
+    def resolve(self, db_name: str, table_name: str) -> pd.DataFrame:
+        return self.table(self.qualified_name(db_name, table_name))
+
+
+def get_session() -> DelphiSession:
+    return DelphiSession.get_or_create()
+
+
+def resolve_input(input: Union[str, pd.DataFrame], session: Optional[DelphiSession] = None) \
+        -> pd.DataFrame:
+    session = session or get_session()
+    if isinstance(input, str):
+        return session.table(input)
+    return input

@@ -1,0 +1,308 @@
+"""Core table layer: dictionary-encoded columnar tables.
+
+This replaces the reference's Spark-side table handling — input validation
+(`RepairApi.scala:34-67`), type whitelists (`RepairBase.scala:41-44`),
+discretization (`RepairApi.scala:126-169`) and error-cell NULL masking
+(`RepairApi.scala:171-211`) — with a TPU-first design: every attribute is
+dictionary-encoded into an ``int32`` code column (NULL = -1) so that all
+downstream statistics (frequency counts, entropies, domain scoring, constraint
+checks) run as dense integer kernels on device over an ``int32[rows, attrs]``
+tensor instead of generated SQL.
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu.session import AnalysisException
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+# Type kinds, mirroring the reference's whitelist (RepairBase.scala:41-44):
+# integral+fractional are "continuous", strings are "discrete"; anything else
+# is unsupported.
+KIND_STRING = "string"
+KIND_INTEGRAL = "integral"
+KIND_FRACTIONAL = "fractional"
+
+NULL_CODE = -1
+
+
+def column_kind(series: pd.Series) -> str:
+    dt = series.dtype
+    if pd.api.types.is_bool_dtype(dt):
+        raise AnalysisException(
+            "Supported types are tinyint,smallint,int,bigint,float,double,string, but "
+            "unsupported ones found: boolean")
+    if pd.api.types.is_integer_dtype(dt):
+        return KIND_INTEGRAL
+    if pd.api.types.is_float_dtype(dt):
+        return KIND_FRACTIONAL
+    if pd.api.types.is_object_dtype(dt) or pd.api.types.is_string_dtype(dt):
+        return KIND_STRING
+    raise AnalysisException(
+        "Supported types are tinyint,smallint,int,bigint,float,double,string, but "
+        f"unsupported ones found: {dt}")
+
+
+def _value_strings(series: pd.Series, kind: str) -> np.ndarray:
+    """String representation of values, matching SQL CAST(x AS STRING)."""
+    if kind == KIND_INTEGRAL:
+        return series.map(lambda v: str(int(v)) if pd.notna(v) else None).to_numpy(dtype=object)
+    if kind == KIND_FRACTIONAL:
+        return series.map(lambda v: str(float(v)) if pd.notna(v) else None).to_numpy(dtype=object)
+    return series.map(lambda v: str(v) if pd.notna(v) else None).to_numpy(dtype=object)
+
+
+@dataclass
+class EncodedColumn:
+    """One dictionary-encoded attribute.
+
+    ``codes`` holds int32 dictionary codes (−1 for NULL) into ``vocab`` — the
+    distinct value strings in first-appearance order. Numeric attributes also
+    retain a float64 view (NaN for NULL) for regression / outlier kernels.
+    """
+
+    name: str
+    kind: str
+    codes: np.ndarray
+    vocab: np.ndarray
+    numeric: Optional[np.ndarray] = None
+
+    @property
+    def domain_size(self) -> int:
+        """# of distinct non-NULL values (Catalyst column-stat distinctCount)."""
+        return int(len(self.vocab))
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (KIND_INTEGRAL, KIND_FRACTIONAL)
+
+    def null_mask(self) -> np.ndarray:
+        return self.codes == NULL_CODE
+
+    def decode(self) -> np.ndarray:
+        """Back to an object array of value strings (None for NULL)."""
+        out = np.empty(len(self.codes), dtype=object)
+        valid = self.codes >= 0
+        out[valid] = self.vocab[self.codes[valid]]
+        out[~valid] = None
+        return out
+
+
+def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColumn:
+    kind = column_kind(series)
+    strings = _value_strings(series, kind)
+    codes, uniques = pd.factorize(strings, use_na_sentinel=True)
+    col = EncodedColumn(
+        name=name or str(series.name),
+        kind=kind,
+        codes=codes.astype(np.int32),
+        vocab=np.asarray(uniques, dtype=object),
+    )
+    if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
+        col.numeric = pd.to_numeric(series, errors="coerce").to_numpy(dtype=np.float64)
+    return col
+
+
+@dataclass
+class EncodedTable:
+    """A row-id column plus dictionary-encoded attribute columns.
+
+    The ``codes()`` matrix (``int32[n_rows, n_attrs]``) is the canonical
+    device-side representation: row-shardable over a mesh, NULL = −1.
+    """
+
+    row_id: str
+    row_id_values: np.ndarray
+    row_id_kind: str
+    columns: List[EncodedColumn] = field(default_factory=list)
+
+    @property
+    def n_rows(self) -> int:
+        return int(len(self.row_id_values))
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column(self, name: str) -> EncodedColumn:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise AnalysisException(f"Column '{name}' not found")
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    def codes(self, names: Optional[Sequence[str]] = None) -> np.ndarray:
+        cols = [self.column(n) for n in names] if names is not None else self.columns
+        if not cols:
+            return np.zeros((self.n_rows, 0), dtype=np.int32)
+        return np.stack([c.codes for c in cols], axis=1)
+
+    def domain_stats(self) -> Dict[str, int]:
+        return {c.name: c.domain_size for c in self.columns}
+
+    def continuous_columns(self) -> List[str]:
+        return [c.name for c in self.columns if c.is_numeric]
+
+    def value_string(self, name: str, row: int) -> Optional[str]:
+        c = self.column(name)
+        code = int(c.codes[row])
+        return None if code == NULL_CODE else str(c.vocab[code])
+
+    def row_index(self) -> Dict[object, int]:
+        return {rid: i for i, rid in enumerate(self.row_id_values.tolist())}
+
+    def to_pandas(self) -> pd.DataFrame:
+        """Decode to a pandas frame with original dtypes (numeric restored)."""
+        data: Dict[str, object] = {self.row_id: self.row_id_values}
+        for c in self.columns:
+            if c.is_numeric:
+                assert c.numeric is not None
+                if c.kind == KIND_INTEGRAL and not np.isnan(c.numeric).any():
+                    data[c.name] = c.numeric.astype(np.int64)
+                else:
+                    data[c.name] = c.numeric
+            else:
+                data[c.name] = c.decode()
+        return pd.DataFrame(data)
+
+    def with_nulls_at(self, cells: Sequence[Tuple[int, str]]) -> "EncodedTable":
+        """Returns a copy with the given (row_index, attribute) cells NULLed —
+        the encoded-tensor equivalent of `convertErrorCellsToNull`
+        (RepairApi.scala:171-211)."""
+        by_attr: Dict[str, List[int]] = {}
+        for row, attr in cells:
+            by_attr.setdefault(attr, []).append(row)
+        new_columns = []
+        for c in self.columns:
+            if c.name in by_attr:
+                idx = np.asarray(by_attr[c.name], dtype=np.int64)
+                codes = c.codes.copy()
+                codes[idx] = NULL_CODE
+                numeric = None
+                if c.numeric is not None:
+                    numeric = c.numeric.copy()
+                    numeric[idx] = np.nan
+                new_columns.append(replace(c, codes=codes, numeric=numeric))
+            else:
+                new_columns.append(c)
+        return replace(self, columns=new_columns)
+
+
+def encode_table(df: pd.DataFrame, row_id: str) -> EncodedTable:
+    if row_id not in df.columns:
+        raise AnalysisException(f"Column '{row_id}' does not exist")
+    table = EncodedTable(
+        row_id=row_id,
+        row_id_values=df[row_id].to_numpy(),
+        row_id_kind=column_kind(df[row_id]),
+    )
+    for name in df.columns:
+        if name == row_id:
+            continue
+        table.columns.append(encode_column(df[name], name))
+    return table
+
+
+def check_input_table(df: pd.DataFrame, row_id: str, qualified_name: str = "input") \
+        -> Tuple[EncodedTable, List[str]]:
+    """Input validation, mirroring `RepairApi.checkInputTable`
+    (RepairApi.scala:34-67): type whitelist, ≥3 columns, row-id uniqueness.
+    Returns the encoded table and the list of continuous (numeric) attributes.
+    """
+    for name in df.columns:
+        column_kind(df[name])  # raises AnalysisException on unsupported types
+
+    if len(df.columns) < 3:
+        raise AnalysisException(
+            f"A least three columns (`{row_id}` columns + two more ones) "
+            f"in table '{qualified_name}'")
+
+    if row_id not in df.columns:
+        raise AnalysisException(f"Column '{row_id}' does not exist in '{qualified_name}'.")
+
+    n_rows = len(df)
+    n_distinct = df[row_id].nunique(dropna=False)
+    if n_distinct != n_rows:
+        raise AnalysisException(
+            f"Uniqueness does not hold in column '{row_id}' of table '{qualified_name}' "
+            f"(# of distinct '{row_id}': {n_distinct}, # of rows: {n_rows})")
+
+    table = encode_table(df, row_id)
+    return table, table.continuous_columns()
+
+
+@dataclass
+class DiscretizedTable:
+    """The discretized view used by the stats engine.
+
+    Continuous attributes are equi-width binned into ``[0, discrete_threshold]``
+    (the reference truncates `int((v - min) / (max - min) * threshold)` so the
+    max value lands in bin == threshold — `RepairApi.scala:139`); discrete
+    attributes with domain size in (1, threshold] are kept as-is; everything
+    else is dropped (`RepairApi.scala:126-149`).
+
+    ``domain_stats`` intentionally records the ORIGINAL distinct counts (not
+    bin counts) to match `convertToDiscretizedTable` (RepairApi.scala:151-169),
+    which feeds those into entropy corrections and domain thresholds.
+    """
+
+    base: EncodedTable
+    table: EncodedTable
+    domain_stats: Dict[str, int]
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.table.column_names
+
+
+def discretize_table(table: EncodedTable, discrete_threshold: int) -> DiscretizedTable:
+    assert 2 <= discrete_threshold < 65536, "discreteThreshold should be in [2, 65536)."
+
+    out_columns: List[EncodedColumn] = []
+    domain_stats: Dict[str, int] = {}
+    for c in table.columns:
+        domain_stats[c.name] = c.domain_size
+        if c.is_numeric:
+            assert c.numeric is not None
+            valid = ~np.isnan(c.numeric)
+            if not valid.any():
+                _logger.warning(f"'{c.name}' dropped because it has no non-NULL value")
+                continue
+            vmin = float(np.nanmin(c.numeric))
+            vmax = float(np.nanmax(c.numeric))
+            width = vmax - vmin
+            bins = np.full(table.n_rows, NULL_CODE, dtype=np.int64)
+            if width > 0.0:
+                scaled = (c.numeric[valid] - vmin) / width * discrete_threshold
+                bins[valid] = scaled.astype(np.int64)
+            else:
+                bins[valid] = 0
+            # Re-encode bins compactly: vocab entries are the bin values as
+            # strings (what CAST(int AS STRING) would yield in the reference).
+            present = np.unique(bins[bins >= 0])
+            remap = {int(b): i for i, b in enumerate(present)}
+            codes = np.array([remap[int(b)] if b >= 0 else NULL_CODE for b in bins],
+                             dtype=np.int32)
+            vocab = np.asarray([str(int(b)) for b in present], dtype=object)
+            out_columns.append(EncodedColumn(name=c.name, kind=KIND_STRING,
+                                             codes=codes, vocab=vocab))
+        elif 1 < c.domain_size <= discrete_threshold:
+            out_columns.append(c)
+        else:
+            _logger.warning(
+                f"'{c.name}' dropped because of its unsuitable domain (size={c.domain_size})")
+
+    discretized = EncodedTable(
+        row_id=table.row_id,
+        row_id_values=table.row_id_values,
+        row_id_kind=table.row_id_kind,
+        columns=out_columns,
+    )
+    return DiscretizedTable(base=table, table=discretized, domain_stats=domain_stats)

@@ -1,0 +1,185 @@
+"""Shared utilities: logging, option parsing, runtime argument type checks, timing.
+
+TPU-native re-implementation of the reference's helpers
+(`/root/reference/python/repair/utils.py:31-230`): same observable behavior
+(option validation that warns or raises under testing, `@argtype_check`
+inspecting annotations, `@elapsed_time` returning ``(result, seconds)``),
+no Spark.
+"""
+
+import functools
+import inspect
+import itertools
+import logging
+import os
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+_LOGGER_NAME = "delphi_tpu"
+
+
+def setup_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    logger.setLevel(logging.INFO)
+    if not logger.handlers:
+        logger.addHandler(logging.NullHandler())
+    return logger
+
+
+_logger = setup_logger()
+
+_view_counter = itertools.count()
+
+
+def to_list_str(d: List[Any], sep: str = ",", quote: bool = False) -> str:
+    return sep.join(f"'{e}'" if quote else str(e) for e in d)
+
+
+def get_random_string(prefix: str) -> str:
+    # A monotonically increasing counter keeps generated names unique within a
+    # process (the reference's timestamp-based names can collide sub-second).
+    return f"{prefix}_{next(_view_counter)}"
+
+
+def is_testing() -> bool:
+    return os.environ.get("DELPHI_TESTING") is not None \
+        or os.environ.get("SPARK_TESTING") is not None
+
+
+def get_option_value(opts: Dict[str, str], key: str, default_value: Any,
+                     type_class: Any = str, validator: Optional[Any] = None,
+                     err_msg: Optional[str] = None) -> Any:
+    """Typed lookup of a string-keyed expert option with validation.
+
+    Mirrors reference `utils.py:50-75`: a bad value raises under testing and
+    falls back to the default (with a warning) otherwise.
+    """
+    assert type(default_value) is type_class, f"key={key}"
+
+    if key not in opts:
+        return default_value
+
+    raw = opts[key]
+    try:
+        if type_class is bool and isinstance(raw, str):
+            # bool("") is False, bool("false") is True; the reference relies on
+            # Python truthiness of the raw string, so keep that behavior.
+            value = bool(raw)
+        else:
+            value = type_class(raw)
+    except Exception:
+        msg = f'Failed to cast "{raw}" into {type_class.__name__} data: key={key}'
+        if is_testing():
+            raise ValueError(msg)
+        _logger.warning(msg)
+        return default_value
+
+    if validator and not validator(value):
+        msg = f"{str(err_msg).format(key)}, got {value}"
+        if is_testing():
+            raise ValueError(msg)
+        _logger.warning(msg)
+        return default_value
+
+    return value
+
+
+def _pretty_type_name(t: Any) -> str:
+    origin = getattr(t, "__origin__", None)
+    if origin is list:
+        return f"list[{_pretty_type_name(t.__args__[0])}]"
+    if origin is dict:
+        kt, vt = t.__args__
+        return f"dict[{_pretty_type_name(kt)},{_pretty_type_name(vt)}]"
+    return getattr(t, "__name__", str(t))
+
+
+def _type_matches(v: Any, annot: Any) -> bool:
+    origin = getattr(annot, "__origin__", None)
+    if origin is list:
+        return isinstance(v, list) and all(_type_matches(x, annot.__args__[0]) for x in v)
+    if origin is dict:
+        kt, vt = annot.__args__
+        return isinstance(v, dict) \
+            and all(_type_matches(k, kt) for k in v.keys()) \
+            and all(_type_matches(x, vt) for x in v.values())
+    if origin is typing.Union:
+        return any(_type_matches(v, t) for t in annot.__args__)
+    try:
+        return type(v) is annot or isinstance(v, annot)
+    except TypeError:
+        return False
+
+
+def argtype_check(f):  # type: ignore
+    """Runtime type checking of public API arguments based on annotations.
+
+    Same contract as reference `utils.py:149-216`; raises ``TypeError`` with a
+    '`arg` should be provided as T, got U' message.
+    """
+
+    @functools.wraps(f)
+    def wrapper(self, *args, **kwargs):  # type: ignore
+        sig = inspect.signature(f)
+        for name, value in sig.bind(self, *args, **kwargs).arguments.items():
+            annot = sig.parameters[name].annotation
+            if annot is inspect.Signature.empty or name == "self":
+                continue
+            if not _type_matches(value, annot):
+                origin = getattr(annot, "__origin__", None)
+                if origin is typing.Union:
+                    req = "/".join(_pretty_type_name(t) for t in annot.__args__)
+                else:
+                    req = _pretty_type_name(annot)
+                raise TypeError(
+                    f"`{name}` should be provided as {req}, got {type(value).__name__}")
+        return f(self, *args, **kwargs)
+
+    return wrapper
+
+
+def elapsed_time(f):  # type: ignore
+    """Wraps a method so it returns ``(result, wall_seconds)``."""
+
+    @functools.wraps(f)
+    def wrapper(self, *args, **kwargs):  # type: ignore
+        start = time.time()
+        ret = f(self, *args, **kwargs)
+        return ret, time.time() - start
+
+    return wrapper
+
+
+class phase_span:
+    """Phase-scoped timing span: the TPU-native analog of the reference's
+    `@spark_job_group` (`utils.py:130-146`) + Spark job descriptions.
+
+    Logs phase wall time; nesting is allowed. Also usable as a decorator via
+    :func:`job_phase`.
+    """
+
+    _active: List[str] = []
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._t0 = 0.0
+
+    def __enter__(self) -> "phase_span":
+        phase_span._active.append(self.name)
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        phase_span._active.pop()
+        _logger.info(f"Elapsed time (name: {self.name}) is {time.time() - self._t0}(s)")
+
+
+def job_phase(name: str):  # type: ignore
+    def decorator(f):  # type: ignore
+        @functools.wraps(f)
+        def wrapper(self, *args, **kwargs):  # type: ignore
+            with phase_span(name):
+                return f(self, *args, **kwargs)
+        return wrapper
+    return decorator

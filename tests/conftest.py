@@ -1,0 +1,53 @@
+"""Test harness: force CPU jax with 8 virtual devices so mesh/sharding tests
+run without TPU hardware (the reference tests similarly use local[4] Spark —
+testutils.py:65-80)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DELPHI_TESTING", "1")
+
+import pathlib
+import sys
+
+import pandas as pd
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+TESTDATA = pathlib.Path("/root/reference/testdata")
+BIN_TESTDATA = pathlib.Path("/root/reference/bin/testdata")
+
+
+def load_testdata(name: str, **kwargs) -> pd.DataFrame:
+    for base in (BIN_TESTDATA, TESTDATA):
+        path = base / name
+        if path.exists():
+            return pd.read_csv(path, **kwargs)
+    raise FileNotFoundError(name)
+
+
+@pytest.fixture
+def adult_df() -> pd.DataFrame:
+    return load_testdata("adult.csv")
+
+
+@pytest.fixture
+def hospital_df() -> pd.DataFrame:
+    return load_testdata("hospital.csv", dtype=str).astype({"tid": int})
+
+
+@pytest.fixture
+def session():
+    from delphi_tpu.session import get_session
+    s = get_session()
+    yield s
+    # Sessions are process-wide; drop everything tests registered.
+    for name in list(s.table_names()):
+        s.drop(name)

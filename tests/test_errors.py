@@ -1,0 +1,242 @@
+"""Detector behavior tests, mirroring the reference's ErrorDetectorSuite and
+python test_errors.py coverage."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import constraints as dc
+from delphi_tpu.errors import (
+    ConstraintErrorDetector, DomainValues, ErrorModel, GaussianOutlierErrorDetector,
+    LOFOutlierErrorDetector, NullErrorDetector, RegExErrorDetector, ROW_IDX,
+    ScikitLearnBackedErrorDetector)
+from delphi_tpu.table import encode_table
+
+
+def _cells(df, row_id="tid"):
+    return sorted(zip(df[row_id].tolist(), df["attribute"].tolist()))
+
+
+def _setup(detector, df, row_id="tid", targets=None, continuous=None):
+    table = encode_table(df, row_id)
+    all_targets = targets if targets is not None else table.column_names
+    detector.setUp(row_id, "test_input", continuous or [], all_targets,
+                   encoded_table=table)
+    return detector
+
+
+def test_null_detector(adult_df):
+    d = _setup(NullErrorDetector(), adult_df)
+    got = _cells(d.detect())
+    assert got == [(3, "Sex"), (5, "Age"), (5, "Income"),
+                   (7, "Sex"), (12, "Age"), (12, "Sex"), (16, "Income")]
+
+
+def test_null_detector_with_targets(adult_df):
+    d = _setup(NullErrorDetector(), adult_df, targets=["Sex"])
+    assert _cells(d.detect()) == [(3, "Sex"), (7, "Sex"), (12, "Sex")]
+
+
+def test_regex_detector():
+    df = pd.DataFrame({"tid": [0, 1, 2, 3],
+                       "v": ["123", "abc", "45", None],
+                       "w": ["a", "b", "c", "d"]})
+    d = _setup(RegExErrorDetector("v", r"^[0-9]+$"), df)
+    assert _cells(d.detect()) == [(1, "v"), (3, "v")]
+
+
+def test_regex_detector_partial_match_semantics():
+    # RLIKE is a *search*, not a full match (ErrorDetectorApi.scala:179)
+    df = pd.DataFrame({"tid": [0, 1], "v": ["alabama", "zz"], "w": ["a", "b"]})
+    d = _setup(RegExErrorDetector("v", "al|ak"), df)
+    assert _cells(d.detect()) == [(1, "v")]
+
+
+def test_regex_detector_invalid_regex_is_empty():
+    df = pd.DataFrame({"tid": [0], "v": ["x"], "w": ["y"]})
+    d = _setup(RegExErrorDetector("v", "("), df)
+    assert len(d.detect()) == 0
+
+
+def test_domain_values_detector():
+    df = pd.DataFrame({"tid": [0, 1, 2], "v": ["yes", "no", "maybe"], "w": list("abc")})
+    d = _setup(DomainValues("v", values=["yes", "no"]), df)
+    assert _cells(d.detect()) == [(2, "v")]
+
+
+def test_domain_values_autofill():
+    df = pd.DataFrame({
+        "tid": range(8),
+        "v": ["a"] * 5 + ["b", "b", "typo"],
+        "w": list("abcdefgh"),
+    })
+    d = _setup(DomainValues("v", autofill=True, min_count_thres=4), df)
+    # only 'a' clears the count threshold; everything else is flagged
+    assert _cells(d.detect()) == [(5, "v"), (6, "v"), (7, "v")]
+
+
+def test_gaussian_outlier_detector():
+    values = [1.0] * 10 + [1000.0]
+    df = pd.DataFrame({"tid": range(11), "v": values, "w": list("abcdefghijk")})
+    d = _setup(GaussianOutlierErrorDetector(), df, continuous=["v"])
+    assert _cells(d.detect()) == [(10, "v")]
+
+
+def test_lof_outlier_detector():
+    rng = np.random.RandomState(42)
+    vals = np.concatenate([rng.normal(0, 1, 50), [50.0]])
+    df = pd.DataFrame({"tid": range(51), "v": vals, "w": ["x"] * 51})
+    d = _setup(LOFOutlierErrorDetector(), df, continuous=["v"])
+    assert (50, "v") in _cells(d.detect())
+
+
+def test_sklearn_backed_detector():
+    class Always0Outlier:
+        def fit_predict(self, X):
+            out = np.ones(len(X))
+            out[0] = -1
+            return out
+
+    df = pd.DataFrame({"tid": [7, 8, 9], "v": [1.0, 2.0, 3.0], "w": list("abc")})
+    d = _setup(ScikitLearnBackedErrorDetector(lambda: Always0Outlier()), df,
+               continuous=["v"])
+    assert _cells(d.detect()) == [(7, "v")]
+
+
+def test_sklearn_backed_detector_validation():
+    with pytest.raises(ValueError, match="fit_predict"):
+        ScikitLearnBackedErrorDetector(lambda: object())
+
+
+# --- denial constraints -----------------------------------------------------
+
+def test_parse_two_tuple():
+    preds = dc.parse("t1&t2&EQ(t1.a,t2.a)&IQ(t1.b,t2.b)")
+    assert [p.sign for p in preds] == ["EQ", "IQ"]
+    assert preds[0].references == ["a"]
+    assert preds[1].references == ["b"]
+
+
+def test_parse_one_tuple_constants():
+    preds = dc.parse('t1&EQ(t1.Sex,"Female")&EQ(t1.Relationship,"Husband")')
+    assert [p.sign for p in preds] == ["EQ", "EQ"]
+    assert isinstance(preds[0].right, dc.Constant)
+    assert preds[0].right.literal == "Female"
+
+
+def test_parse_fd_sugar():
+    preds = dc.parse_alt("X->Y")
+    assert [p.sign for p in preds] == ["EQ", "IQ"]
+    assert preds[0].references == ["X"]
+    assert preds[1].references == ["Y"]
+
+
+def test_parse_verify_drops_unknown_attrs():
+    parsed = dc.parse_and_verify_constraints(
+        ["t1&t2&EQ(t1.a,t2.a)&IQ(t1.b,t2.b)",
+         "t1&t2&EQ(t1.zzz,t2.zzz)&IQ(t1.b,t2.b)"],
+        "t", ["a", "b"])
+    assert len(parsed.predicates) == 1
+    assert parsed.references == ["a", "b"]
+
+
+def test_parse_invalid_returns_nothing():
+    parsed = dc.parse_and_verify_constraints(["garbage input"], "t", ["a"])
+    assert parsed.is_empty
+
+
+def test_constraint_detector_fd_violation():
+    # a -> b functional dependency violated by rows 0/1
+    df = pd.DataFrame({
+        "tid": [0, 1, 2, 3],
+        "a": ["k1", "k1", "k2", "k2"],
+        "b": ["v1", "v2", "v3", "v3"],
+    })
+    d = _setup(ConstraintErrorDetector(
+        constraints="t1&t2&EQ(t1.a,t2.a)&IQ(t1.b,t2.b)"), df)
+    assert _cells(d.detect()) == [(0, "a"), (0, "b"), (1, "a"), (1, "b")]
+
+
+def test_constraint_detector_null_safe_iq():
+    # NULL <=> value is false, so NOT(<=>) flags NULL-vs-value groups
+    df = pd.DataFrame({
+        "tid": [0, 1, 2, 3],
+        "a": ["k1", "k1", "k2", "k2"],
+        "b": ["v1", None, "v3", "v3"],
+    })
+    d = _setup(ConstraintErrorDetector(
+        constraints="t1&t2&EQ(t1.a,t2.a)&IQ(t1.b,t2.b)"), df)
+    assert _cells(d.detect()) == [(0, "a"), (0, "b"), (1, "a"), (1, "b")]
+
+
+def test_constraint_detector_lt():
+    df = pd.DataFrame({
+        "tid": [0, 1, 2],
+        "a": ["g", "g", "g"],
+        "b": [3, 1, 2],
+    })
+    # violation when some same-group row has larger b: rows 1 and 2 (row 0 is
+    # the group max, so no r2 with larger b exists)
+    d = _setup(ConstraintErrorDetector(
+        constraints="t1&t2&EQ(t1.a,t2.a)&LT(t1.b,t2.b)"), df)
+    assert _cells(d.detect()) == [(1, "a"), (1, "b"), (2, "a"), (2, "b")]
+
+
+def test_constraint_detector_one_tuple(adult_df):
+    d = _setup(ConstraintErrorDetector(
+        constraint_path="/root/reference/testdata/adult_constraints.txt"), adult_df)
+    got = _cells(d.detect())
+    # rows where Sex=Female & Relationship=Husband, or Sex=Male & Relationship=Wife
+    raw = adult_df
+    bad1 = raw[(raw.Sex == "Female") & (raw.Relationship == "Husband")].tid.tolist()
+    bad2 = raw[(raw.Sex == "Male") & (raw.Relationship == "Wife")].tid.tolist()
+    expected = sorted([(t, a) for t in bad1 + bad2 for a in ("Sex", "Relationship")],
+                      key=lambda x: (x[0], x[1]))
+    assert got == sorted(expected)
+
+
+def test_constraint_detector_targets_filter():
+    df = pd.DataFrame({
+        "tid": [0, 1],
+        "a": ["k", "k"],
+        "b": ["v1", "v2"],
+    })
+    d = _setup(ConstraintErrorDetector(
+        constraints="t1&t2&EQ(t1.a,t2.a)&IQ(t1.b,t2.b)"), df, targets=["b"])
+    assert _cells(d.detect()) == [(0, "b"), (1, "b")]
+
+
+def test_constraint_detector_hospital_runs(hospital_df):
+    d = _setup(ConstraintErrorDetector(
+        constraint_path="/root/reference/testdata/hospital_constraints.txt"),
+        hospital_df)
+    cells = d.detect()
+    assert len(cells) > 0
+    assert set(cells["attribute"].unique()) <= set(hospital_df.columns)
+
+
+# --- ErrorModel pipeline ----------------------------------------------------
+
+def test_error_model_weak_labeling(adult_df):
+    table = encode_table(adult_df, "tid")
+    em = ErrorModel(row_id="tid", targets=[], discrete_thres=80,
+                    error_detectors=[NullErrorDetector()], error_cells=None, opts={})
+    error_cells_df, target_columns, pairwise, domain_stats = \
+        em.detect(table, "adult", [])
+    # NULL cells can never be weak-labeled to their current value (None)
+    assert len(error_cells_df) == 7
+    assert set(target_columns) <= set(table.column_names)
+    assert "Sex" in target_columns and "Age" in target_columns
+    assert domain_stats["Sex"] == 2
+    assert all(k in pairwise for k in target_columns)
+
+
+def test_error_model_given_error_cells(adult_df, session):
+    table = encode_table(adult_df, "tid")
+    cells = pd.DataFrame({"tid": [3, 12, 999], "attribute": ["Sex", "Age", "Sex"]})
+    em = ErrorModel(row_id="tid", targets=[], discrete_thres=80,
+                    error_detectors=[], error_cells=cells, opts={})
+    error_cells_df, target_columns, _, _ = em.detect(table, "adult", [])
+    # unknown row 999 is dropped; given cells are trusted (no weak labeling)
+    assert _cells(error_cells_df) == [(3, "Sex"), (12, "Age")]
+    assert error_cells_df["current_value"].isna().all()

@@ -1,0 +1,163 @@
+"""Golden tests for the freq/entropy/domain kernels, mirroring the reference's
+RepairSuite expectations (RepairSuite.scala:237-512)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu.ops.domain import compute_domain_in_error_cells
+from delphi_tpu.ops.entropy import compute_pairwise_stats, select_candidate_pairs
+from delphi_tpu.ops.freq import FreqStats, PairDistinctCounter, compute_freq_stats
+from delphi_tpu.table import discretize_table, encode_table
+
+
+@pytest.fixture
+def xy_table():
+    # RepairSuite.scala:240-252
+    df = pd.DataFrame({
+        "tid": range(1, 10),
+        "x": ["1", "2", "3", "2", "1", "1", "3", "3", "2"],
+        "y": ["test-1", "test-2", "test-3", "test-2", "test-1", "test-1",
+              "test-3", "test-3", "test-2a"],
+    })
+    return encode_table(df, "tid")
+
+
+def _counts(stats, attr, table):
+    vocab = table.column(attr).vocab
+    c = stats.single(attr)
+    return {(None if i == 0 else vocab[i - 1]): int(v)
+            for i, v in enumerate(c) if v > 0}
+
+
+def test_compute_freq_stats_golden(xy_table):
+    # RepairSuite.scala:255-268
+    stats = compute_freq_stats(xy_table, ["x", "y"], [("x", "y")], 0.0)
+    assert _counts(stats, "x", xy_table) == {"1": 3, "2": 3, "3": 3}
+    assert _counts(stats, "y", xy_table) == \
+        {"test-1": 3, "test-2": 2, "test-2a": 1, "test-3": 3}
+    m = stats.pair("x", "y")
+    vx = list(xy_table.column("x").vocab)
+    vy = list(xy_table.column("y").vocab)
+    assert m[vx.index("1") + 1, vy.index("test-1") + 1] == 3
+    assert m[vx.index("2") + 1, vy.index("test-2") + 1] == 2
+    assert m[vx.index("2") + 1, vy.index("test-2a") + 1] == 1
+    assert m[vx.index("3") + 1, vy.index("test-3") + 1] == 3
+    assert int(m.sum()) == 9
+
+
+def test_compute_freq_stats_threshold(xy_table):
+    # RepairSuite.scala:269-278: HAVING cnt > int(9 * 0.3) keeps cnt >= 3
+    stats = compute_freq_stats(xy_table, ["x", "y"], [("x", "y")], 0.3)
+    assert _counts(stats, "x", xy_table) == {"1": 3, "2": 3, "3": 3}
+    assert _counts(stats, "y", xy_table) == {"test-1": 3, "test-3": 3}
+    assert int((stats.pair("x", "y") > 0).sum()) == 2  # (1,test-1), (3,test-3)
+
+
+def test_pairwise_stats_worst_case_no_freq_stats():
+    # RepairSuite.scala:312-332: empty stats -> correction-only entropies
+    empty = FreqStats(
+        n_rows=1000, attrs=["x", "y"], vocab_sizes={"x": 0, "y": 0},
+        singles={"x": np.zeros(1, np.int64), "y": np.zeros(1, np.int64)},
+        pairs={("x", "y"): np.zeros((1, 1), np.int64)})
+    stats = compute_pairwise_stats(
+        1000, empty, [("x", "y"), ("y", "x")], {"tid": 9, "x": 2, "y": 4})
+    assert set(stats.keys()) == {"x", "y"}
+    assert stats["x"] == [("y", pytest.approx(1.0))]
+    assert stats["y"] == [("x", pytest.approx(2.0))]
+
+
+def test_pairwise_stats_positive(xy_table):
+    # RepairSuite.scala:334-364 analog on the 9-row fixture
+    stats = compute_freq_stats(xy_table, ["x", "y"], [("x", "y")], 0.0)
+    pw = compute_pairwise_stats(9, stats, [("x", "y"), ("y", "x")],
+                                {"tid": 9, "x": 3, "y": 4})
+    assert set(pw.keys()) == {"x", "y"}
+    # y functionally determines x in this fixture, so H(x|y) == 0;
+    # x does not determine y (x=2 -> {test-2, test-2a}), so H(y|x) > 0.
+    assert pw["x"][0][0] == "y" and pw["x"][0][1] == pytest.approx(0.0)
+    assert pw["y"][0][0] == "x" and pw["y"][0][1] > 0.0
+
+
+def test_pairwise_stats_threshold_increases_entropy(xy_table):
+    # RepairSuite.scala:415-424: filtering out freq groups raises H via the
+    # missing-mass correction
+    s0 = compute_freq_stats(xy_table, ["x", "y"], [("x", "y")], 0.0)
+    s1 = compute_freq_stats(xy_table, ["x", "y"], [("x", "y")], 1.0)
+    pw0 = compute_pairwise_stats(9, s0, [("x", "y"), ("y", "x")],
+                                 {"x": 3, "y": 4})
+    pw1 = compute_pairwise_stats(9, s1, [("x", "y"), ("y", "x")],
+                                 {"x": 3, "y": 4})
+    assert pw0["x"][0][1] <= 1.0
+    assert pw0["x"][0][1] < pw1["x"][0][1]
+
+
+def test_select_candidate_pairs_no_pruning(xy_table):
+    pairs = select_candidate_pairs(
+        PairDistinctCounter(xy_table), ["x", "y"], ["x", "y"],
+        {"x": 3, "y": 4}, 1.0, 256)
+    assert pairs == [("x", "y"), ("y", "x")]
+
+
+def test_select_candidate_pairs_pruning():
+    df = pd.DataFrame({
+        "tid": range(8),
+        "a": ["p", "p", "q", "q", "p", "p", "q", "q"],
+        "b": ["p", "p", "q", "q", "p", "p", "q", "q"],  # perfectly correlated with a
+        "c": ["u", "v", "w", "x", "u", "v", "w", "x"],
+    })
+    t = encode_table(df, "tid")
+    ds = {"a": 2, "b": 2, "c": 4}
+    # cap=1 with a permissive threshold keeps the lowest-co-ratio pair
+    pairs = select_candidate_pairs(PairDistinctCounter(t), ["a"], ["a", "b", "c"],
+                                   ds, 1.01, 1)
+    assert pairs == [("a", "b")]  # 2 distinct pairs / 4 < 4 distinct / 8
+
+
+class TestComputeDomain:
+    """Golden test from RepairSuite.scala:429-512."""
+
+    def setup_method(self, method):
+        df = pd.DataFrame({
+            "tid": range(1, 10),
+            "x": ["2", "2", "3", "2", "1", "2", "3", "3", "2"],
+            "y": ["test-1", "test-2", "test-1", "test-2", "test-1", "test-1",
+                  "test-3", "test-3", "test-2a"],
+            "z": [1, 1, 3, 2, 1, 1, 2, 3, 2],
+        })
+        self.table = encode_table(df, "tid")
+        self.disc = discretize_table(self.table, 100)
+        self.freq = compute_freq_stats(
+            self.disc.table, ["x", "y", "z"],
+            [("x", "y"), ("x", "z"), ("y", "z")], 0.0)
+        self.pairwise = {"x": [("y", 1.0)], "y": [("x", 0.846950694324252)]}
+        self.domain_stats = {"tid": 9, "x": 3, "y": 4, "z": 3}
+        self.cells = [(0, "x", "2"), (2, "y", "test-3"), (5, "y", "test-2")]
+
+    def _domains(self, beta):
+        doms = compute_domain_in_error_cells(
+            self.disc, self.cells, ["z"], ["x", "y"], self.freq,
+            self.pairwise, self.domain_stats, 4, 0.0, beta)
+        return {(d.row_index, d.attribute): d for d in doms}
+
+    def test_beta_low_keeps_candidates(self):
+        doms = self._domains(0.01)
+        assert sorted(v for v, _ in doms[(0, "x")].domain) == ["1", "2", "3"]
+        assert sorted(v for v, _ in doms[(2, "y")].domain) == ["test-1", "test-3"]
+        assert sorted(v for v, _ in doms[(5, "y")].domain) == \
+            ["test-1", "test-2", "test-2a"]
+        # probabilities normalize per cell
+        for d in doms.values():
+            assert sum(p for _, p in d.domain) == pytest.approx(1.0)
+        # top value of cell (0, x) is its current value "2" (weak-labelable)
+        assert doms[(0, "x")].domain[0][0] == "2"
+
+    def test_beta_high_prunes(self):
+        doms = self._domains(0.5)
+        assert [v for v, _ in doms[(0, "x")].domain] == ["2"]
+
+    def test_continuous_targets_get_empty_domains(self):
+        doms = compute_domain_in_error_cells(
+            self.disc, [(0, "z", "1")], ["z"], ["z"], self.freq,
+            {"z": [("x", 0.5)]}, self.domain_stats, 4, 0.0, 0.01)
+        assert doms[0].domain == []

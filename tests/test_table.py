@@ -1,0 +1,95 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu.session import AnalysisException
+from delphi_tpu.table import (
+    check_input_table, discretize_table, encode_table, NULL_CODE)
+
+
+def test_encode_roundtrip(adult_df):
+    table = encode_table(adult_df, "tid")
+    assert table.n_rows == 20
+    assert len(table.columns) == 7
+    sex = table.column("Sex")
+    assert sex.kind == "string"
+    assert set(sex.vocab) == {"Male", "Female"}
+    assert int(sex.null_mask().sum()) == 3
+    decoded = table.to_pandas()
+    assert list(decoded.columns) == list(adult_df.columns)
+    assert decoded["Relationship"].tolist() == adult_df["Relationship"].tolist()
+
+
+def test_check_input_table_valid(adult_df):
+    table, continuous = check_input_table(adult_df, "tid")
+    assert continuous == []  # all attributes are strings
+    assert table.domain_stats()["Sex"] == 2
+
+
+def test_check_input_table_row_id_unique():
+    df = pd.DataFrame({"tid": [1, 1, 2], "a": ["x", "y", "z"], "b": [1.0, 2.0, 3.0]})
+    with pytest.raises(AnalysisException, match="Uniqueness does not hold"):
+        check_input_table(df, "tid")
+
+
+def test_check_input_table_min_columns():
+    df = pd.DataFrame({"tid": [1, 2], "a": ["x", "y"]})
+    with pytest.raises(AnalysisException, match="three columns"):
+        check_input_table(df, "tid")
+
+
+def test_check_input_table_unsupported_type():
+    df = pd.DataFrame({"tid": [1, 2], "a": ["x", "y"], "b": [True, False]})
+    with pytest.raises(AnalysisException, match="unsupported"):
+        check_input_table(df, "tid")
+
+
+def test_continuous_attrs_include_integrals():
+    # integral AND fractional types are continuous (RepairBase.scala:41-42)
+    df = pd.DataFrame({"tid": [1, 2, 3], "a": ["x", "y", "z"],
+                       "i": [1, 2, 3], "f": [0.5, 1.5, 2.5]})
+    _, continuous = check_input_table(df, "tid")
+    assert continuous == ["i", "f"]
+
+
+def test_discretize_equi_width():
+    df = pd.DataFrame({
+        "tid": [0, 1, 2, 3],
+        "v": [0.0, 2.5, 5.0, 10.0],
+        "s": ["a", "b", "a", "b"],
+    })
+    table = encode_table(df, "tid")
+    disc = discretize_table(table, 4)
+    # int((v - 0) / 10 * 4): 0, 1, 2, 4 — max value lands in bin == threshold
+    v = disc.table.column("v")
+    assert [v.vocab[c] for c in v.codes] == ["0", "1", "2", "4"]
+    # original distinct counts, not bin counts (RepairApi.scala:162-167)
+    assert disc.domain_stats == {"v": 4, "s": 2}
+
+
+def test_discretize_drops_large_and_constant_domains():
+    df = pd.DataFrame({
+        "tid": range(6),
+        "big": [f"v{i}" for i in range(6)],   # domain size 6 > threshold
+        "const": ["c"] * 6,                   # domain size 1
+        "ok": ["a", "b", "a", "b", "a", "b"],
+    })
+    disc = discretize_table(encode_table(df, "tid"), 4)
+    assert disc.table.column_names == ["ok"]
+    assert disc.domain_stats == {"big": 6, "const": 1, "ok": 2}
+
+
+def test_with_nulls_at(adult_df):
+    table = encode_table(adult_df, "tid")
+    masked = table.with_nulls_at([(0, "Sex"), (1, "Income")])
+    assert masked.column("Sex").codes[0] == NULL_CODE
+    assert masked.column("Income").codes[1] == NULL_CODE
+    # original untouched
+    assert table.column("Sex").codes[0] != NULL_CODE
+    assert int(masked.column("Sex").null_mask().sum()) == 4
+
+
+def test_null_discretized_numeric():
+    df = pd.DataFrame({"tid": [0, 1, 2], "v": [1.0, np.nan, 3.0], "s": ["a", "b", "a"]})
+    disc = discretize_table(encode_table(df, "tid"), 4)
+    assert disc.table.column("v").codes[1] == NULL_CODE
