@@ -1,0 +1,39 @@
+"""delphi_tpu — TPU-native statistical data repair.
+
+A brand-new framework with the capabilities of maropu/spark-data-repair-plugin
+(error-cell detection + statistical repair), built on JAX/XLA: tables are
+dictionary-encoded into row-shardable ``int32`` tensors and all statistics,
+detection, domain analysis, model training and repair inference run as jitted
+kernels on a device mesh.
+
+Public surface mirrors the reference:
+
+    from delphi_tpu import delphi
+    delphi.register_table("adult", df)
+    repaired = delphi.repair \\
+        .setInput("adult").setRowId("tid") \\
+        .setErrorDetectors([NullErrorDetector()]) \\
+        .run()
+"""
+
+from delphi_tpu.api import Delphi
+from delphi_tpu.costs import Levenshtein, UpdateCostFunction, UserDefinedUpdateCostFunction
+from delphi_tpu.errors import (
+    ConstraintErrorDetector, DomainValues, ErrorDetector, GaussianOutlierErrorDetector,
+    LOFOutlierErrorDetector, NullErrorDetector, RegExErrorDetector,
+    ScikitLearnBackedErrorDetector, ScikitLearnBasedErrorDetector)
+from delphi_tpu.misc import RepairMisc
+from delphi_tpu.model import FunctionalDepModel, PoorModel, RepairModel
+
+delphi = Delphi.getOrCreate()
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Delphi", "delphi", "RepairModel", "RepairMisc", "PoorModel",
+    "FunctionalDepModel", "ErrorDetector", "NullErrorDetector", "DomainValues",
+    "RegExErrorDetector", "ConstraintErrorDetector", "GaussianOutlierErrorDetector",
+    "ScikitLearnBasedErrorDetector", "ScikitLearnBackedErrorDetector",
+    "LOFOutlierErrorDetector", "UpdateCostFunction", "Levenshtein",
+    "UserDefinedUpdateCostFunction",
+]

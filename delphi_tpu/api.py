@@ -1,0 +1,59 @@
+"""Delphi API façade (reference `python/repair/api.py:26-63`).
+
+    from delphi_tpu import delphi
+    repaired = delphi.repair.setInput("adult").setRowId("tid").run()
+
+`delphi` is the singleton; `.repair` returns a fresh RepairModel and `.misc` a
+fresh RepairMisc. `register_table` replaces Spark's temp-view registration for
+feeding pandas inputs by name.
+"""
+
+from typing import Any
+
+import pandas as pd
+
+from delphi_tpu.misc import RepairMisc
+from delphi_tpu.model import RepairModel
+from delphi_tpu.session import get_session
+
+
+class Delphi:
+    """A Delphi API set for data repairing.
+
+    * ``repair``: detect errors in input data and infer correct ones.
+    * ``misc``: helper functionalities.
+    """
+
+    _instance: Any = None
+
+    def __new__(cls, *args: Any, **kwargs: Any) -> "Delphi":
+        if cls._instance is None:
+            cls._instance = super(Delphi, cls).__new__(cls)
+        return cls._instance
+
+    @staticmethod
+    def getOrCreate() -> "Delphi":
+        return Delphi()
+
+    @property
+    def repair(self) -> RepairModel:
+        """Returns :class:`RepairModel` to repair input data."""
+        return RepairModel()
+
+    @property
+    def misc(self) -> RepairMisc:
+        """Returns :class:`RepairMisc` for misc helper functions."""
+        return RepairMisc()
+
+    @staticmethod
+    def register_table(name: str, df: pd.DataFrame) -> str:
+        """Registers a pandas DataFrame under a catalog name."""
+        return get_session().register(name, df)
+
+    @staticmethod
+    def table(name: str) -> pd.DataFrame:
+        return get_session().table(name)
+
+    @staticmethod
+    def version() -> str:
+        return "0.1.0-tpu-EXPERIMENTAL"

@@ -1,0 +1,1089 @@
+"""RepairModel: the 3-phase repair pipeline (detect -> train -> repair).
+
+API-compatible re-implementation of the reference's
+`python/repair/model.py:103-1537` — same fluent setters, option keys,
+exclusive run() flags, SCARE-style split of clean/dirty rows, FD rule models,
+PMF computation, cost weighting and maximal-likelihood repair — built on the
+encoded-table kernels instead of Spark SQL + LightGBM:
+
+* error detection / stats / domain analysis: :mod:`delphi_tpu.errors`
+* per-attribute stat models: :mod:`delphi_tpu.models` (JAX)
+* repair inference: batched predictions over the dirty-row block
+
+DataFrames in and out are pandas.
+"""
+
+import copy
+import heapq
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu.costs import UpdateCostFunction
+from delphi_tpu.depgraph import compute_functional_dep_map, compute_functional_deps
+from delphi_tpu.errors import (
+    ConstraintErrorDetector, ErrorDetector, ErrorModel, RegExErrorDetector, ROW_IDX)
+from delphi_tpu.models import FeatureEncoder
+from delphi_tpu.regex_repair import RegexStructureRepair
+from delphi_tpu.session import get_session
+from delphi_tpu.table import (
+    EncodedTable, KIND_INTEGRAL, check_input_table)
+from delphi_tpu.train import (
+    build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
+from delphi_tpu.utils import (
+    argtype_check, elapsed_time, get_option_value, job_phase, setup_logger,
+    to_list_str)
+
+_logger = setup_logger()
+
+
+class PoorModel:
+    """Constant predictor fallback (reference model.py:44-61)."""
+
+    def __init__(self, v: Any) -> None:
+        self.v = v
+
+    @property
+    def classes_(self) -> Any:
+        return np.array([self.v])
+
+    def predict(self, X: Any) -> Any:
+        return [self.v] * len(X)
+
+    def predict_proba(self, X: Any) -> Any:
+        return [np.array([1.0])] * len(X)
+
+
+class FunctionalDepModel:
+    """Rule model looking values up in an FD map x -> y
+    (reference model.py:64-100)."""
+
+    def __init__(self, x: str, fd_map: Dict[str, str]) -> None:
+        self.fd_map = fd_map
+        self.classes = list(set(fd_map.values()))
+        self.x = x
+        self.fd_keypos_map = {c: i for i, c in enumerate(self.classes)}
+
+    @property
+    def classes_(self) -> Any:
+        return np.array(self.classes)
+
+    def predict(self, X: pd.DataFrame) -> Any:
+        return [self.fd_map.get(x, None) for x in X[self.x]]
+
+    def predict_proba(self, X: pd.DataFrame) -> Any:
+        pmf = []
+        for x in X[self.x]:
+            if x in self.fd_map:
+                probs = np.zeros(len(self.classes))
+                probs[self.fd_keypos_map[self.fd_map[x]]] = 1.0
+                pmf.append(probs)
+            else:
+                _logger.warning(f'Unknown "{self.x}" domain value found: {x}')
+                pmf.append(None)
+        return pmf
+
+
+def repair_attrs_from(updates_df: pd.DataFrame, base_df: pd.DataFrame,
+                      row_id: str, continuous_cols: Dict[str, str]) -> pd.DataFrame:
+    """Applies (row_id, attribute, repaired) updates into a table, with
+    type-aware casts for continuous columns (RepairMiscApi.scala:184-247)."""
+    need = {row_id, "attribute", "repaired"}
+    if not need.issubset(updates_df.columns):
+        from delphi_tpu.session import AnalysisException
+        raise AnalysisException(
+            f"Table must have '{row_id}', 'attribute', and 'repaired' columns")
+
+    out = base_df.copy()
+    index_of = {rid: i for i, rid in enumerate(out[row_id].tolist())}
+    for attr, group in updates_df.groupby("attribute"):
+        if attr not in out.columns:
+            continue
+        rows, values = [], []
+        for rid, rep in zip(group[row_id], group["repaired"]):
+            if rid not in index_of:
+                continue
+            rows.append(index_of[rid])
+            if attr in continuous_cols and rep is not None and not pd.isna(rep):
+                kind = continuous_cols[attr]
+                rep = float(rep)
+                if kind == KIND_INTEGRAL:
+                    rep = int(round(rep))
+            values.append(rep)
+        if rows:
+            col = out[attr].copy()
+            if pd.api.types.is_integer_dtype(col.dtype) and any(pd.isna(v) for v in values):
+                col = col.astype("float64")
+            elif pd.api.types.is_integer_dtype(col.dtype):
+                values = [int(v) for v in values]
+            col.iloc[rows] = values
+            out[attr] = col
+    return out
+
+
+class RepairModel:
+    """Fluent repair-model builder (reference model.py:103-1537)."""
+
+    _option = namedtuple("_option", "key default_value type_class validator err_msg")
+
+    _opt_max_training_row_num = \
+        _option("model.max_training_row_num", 10000, int,
+                lambda v: v >= 10, "`{}` should be greater than and equal to 10")
+    _opt_max_training_column_num = \
+        _option("model.max_training_column_num", 65536, int,
+                lambda v: v >= 2, "`{}` should be greater than 1")
+    _opt_small_domain_threshold = \
+        _option("model.small_domain_threshold", 12, int,
+                lambda v: v >= 3, "`{}` should be greater than 2")
+    _opt_repair_by_regex_disabled = \
+        _option("model.rule.repair_by_regex.disabled", True, bool, None, None)
+    _opt_repair_by_nearest_values_disabled = \
+        _option("model.rule.repair_by_nearest_values.disabled", True, bool, None, None)
+    _opt_merge_threshold = \
+        _option("model.rule.merge_threshold", 2.0, float, None, None)
+    _opt_repair_by_functional_deps_disabled = \
+        _option("model.rule.repair_by_functional_deps.disabled", False, bool, None, None)
+    _opt_max_domain_size = \
+        _option("model.rule.max_domain_size", 1000, int,
+                lambda v: v > 10, "`{}` should be greater than 10")
+    _opt_cost_weight = \
+        _option("repair.pmf.cost_weight", 0.1, float,
+                lambda v: v > 0.0, "`{}` should be positive")
+    _opt_prob_threshold = \
+        _option("repair.pmf.prob_threshold", 0.0, float, None, None)
+    _opt_prob_top_k = \
+        _option("repair.pmf.prob_top_k", 32, int,
+                lambda v: v >= 3, "`{}` should be greater than 2")
+
+    option_keys = set([
+        _opt_max_training_row_num.key,
+        _opt_max_training_column_num.key,
+        _opt_small_domain_threshold.key,
+        _opt_repair_by_regex_disabled.key,
+        _opt_repair_by_nearest_values_disabled.key,
+        _opt_merge_threshold.key,
+        _opt_repair_by_functional_deps_disabled.key,
+        _opt_max_domain_size.key,
+        _opt_cost_weight.key,
+        _opt_prob_threshold.key,
+        _opt_prob_top_k.key,
+        *ErrorModel.option_keys,
+        *train_option_keys])
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.db_name: str = ""
+        self.input: Optional[Union[str, pd.DataFrame]] = None
+        self.row_id: Optional[str] = None
+        self.targets: List[str] = []
+
+        self.error_cells: Optional[Union[str, pd.DataFrame]] = None
+        self.error_detectors: List[ErrorDetector] = []
+        self.discrete_thres: int = 80
+
+        self.parallel_stat_training_enabled: bool = False
+        self.training_data_rebalancing_enabled: bool = False
+        self.repair_by_rules: bool = False
+
+        self.repair_delta: Optional[int] = None
+        self.repair_validation_enabled: bool = False
+
+        self.cf: Optional[UpdateCostFunction] = None
+        self.opts: Dict[str, str] = {}
+
+        self._session = get_session()
+        self._registered_views: List[str] = []
+
+    # -- fluent setters ------------------------------------------------------
+
+    @argtype_check  # type: ignore
+    def setDbName(self, db_name: str) -> "RepairModel":
+        if type(self.input) is pd.DataFrame:
+            raise ValueError("Can not specify a database name when input is `DataFrame`")
+        self.db_name = db_name
+        return self
+
+    @argtype_check  # type: ignore
+    def setTableName(self, table_name: str) -> "RepairModel":
+        if not table_name:
+            raise ValueError("`table_name` should have at least character")
+        self.input = table_name
+        return self
+
+    @argtype_check  # type: ignore
+    def setInput(self, input: Union[str, pd.DataFrame]) -> "RepairModel":
+        if type(input) is str:
+            self.setTableName(input)
+        else:
+            self.db_name = ""
+            self.input = input
+        return self
+
+    @argtype_check  # type: ignore
+    def setRowId(self, row_id: str) -> "RepairModel":
+        if not row_id:
+            raise ValueError("`row_id` should have at least character")
+        self.row_id = row_id
+        return self
+
+    @argtype_check  # type: ignore
+    def setTargets(self, attrs: List[str]) -> "RepairModel":
+        if len(attrs) == 0:
+            raise ValueError("`attrs` should have at least one attribute")
+        self.targets = attrs
+        return self
+
+    @argtype_check  # type: ignore
+    def setErrorCells(self, error_cells: Union[str, pd.DataFrame]) -> "RepairModel":
+        if type(error_cells) is str and not error_cells:
+            raise ValueError("`error_cells` should have at least character")
+        if self.row_id is None:
+            raise ValueError("`setRowId` should be called before specifying error cells")
+        df = error_cells if type(error_cells) is pd.DataFrame \
+            else self._session.table(str(error_cells))
+        if not all(c in df.columns for c in [self._row_id, "attribute"]):
+            raise ValueError(
+                f"Error cells should have `{self.row_id}` and `attribute` in columns")
+        self.error_cells = error_cells
+        return self
+
+    @argtype_check  # type: ignore
+    def setErrorDetectors(self, detectors: List[ErrorDetector]) -> "RepairModel":
+        self.error_detectors = detectors
+        return self
+
+    @argtype_check  # type: ignore
+    def setDiscreteThreshold(self, thres: int) -> "RepairModel":
+        if int(thres) < 2:
+            raise ValueError(f"`thres` should be bigger than 1, got {thres}")
+        self.discrete_thres = thres
+        return self
+
+    @argtype_check  # type: ignore
+    def setParallelStatTrainingEnabled(self, enabled: bool) -> "RepairModel":
+        self.parallel_stat_training_enabled = enabled
+        return self
+
+    @argtype_check  # type: ignore
+    def setTrainingDataRebalancingEnabled(self, enabled: bool) -> "RepairModel":
+        self.training_data_rebalancing_enabled = enabled
+        return self
+
+    @argtype_check  # type: ignore
+    def setRepairByRules(self, enabled: bool) -> "RepairModel":
+        self.repair_by_rules = enabled
+        return self
+
+    @argtype_check  # type: ignore
+    def setRepairDelta(self, delta: int) -> "RepairModel":
+        if delta <= 0:
+            raise ValueError(f"Repair delta should be positive, got {delta}")
+        self.repair_delta = int(delta)
+        return self
+
+    @argtype_check  # type: ignore
+    def setUpdateCostFunction(self, cf: UpdateCostFunction) -> "RepairModel":
+        self.cf = cf
+        return self
+
+    @argtype_check  # type: ignore
+    def option(self, key: str, value: str) -> "RepairModel":
+        if key not in self.option_keys:
+            raise ValueError(f"Non-existent key specified: key={key}")
+        self.opts[key] = value
+        return self
+
+    # -- internal helpers ----------------------------------------------------
+
+    def _get_option_value(self, *args) -> Any:  # type: ignore
+        return get_option_value(self.opts, *args)
+
+    @property
+    def _row_id(self) -> str:
+        return str(self.row_id)
+
+    @property
+    def _input_frame(self) -> Tuple[pd.DataFrame, str]:
+        if type(self.input) is pd.DataFrame:
+            return self.input, "input"
+        name = self._session.qualified_name(self.db_name, str(self.input))
+        return self._session.table(name), name
+
+    @property
+    def _error_cells_frame(self) -> Optional[pd.DataFrame]:
+        if self.error_cells is None:
+            return None
+        df = self.error_cells if type(self.error_cells) is pd.DataFrame \
+            else self._session.table(str(self.error_cells))
+        return df[[self._row_id, "attribute"]]
+
+    @property
+    def _repair_by_regex_enabled(self) -> bool:
+        return not bool(self._get_option_value(*self._opt_repair_by_regex_disabled)) \
+            and self.repair_by_rules
+
+    @property
+    def _repair_by_nearest_values_enabled(self) -> bool:
+        return not bool(self._get_option_value(*self._opt_repair_by_nearest_values_disabled)) \
+            and self.repair_by_rules and self.cf is not None
+
+    @property
+    def _repair_by_functional_deps_enabled(self) -> bool:
+        return not bool(self._get_option_value(*self._opt_repair_by_functional_deps_disabled)) \
+            and self.repair_by_rules
+
+    def _filter_columns_from(self, df: pd.DataFrame, targets: List[str],
+                             negate: bool = False) -> pd.DataFrame:
+        mask = df["attribute"].isin(targets)
+        return df[~mask if negate else mask].reset_index(drop=True)
+
+    # -- phase 1: error detection --------------------------------------------
+
+    def _detect_errors(self, table: EncodedTable, input_name: str,
+                       continuous_columns: List[str]) -> Any:
+        error_model = ErrorModel(
+            row_id=self._row_id,
+            targets=self.targets,
+            discrete_thres=self.discrete_thres,
+            error_detectors=self.error_detectors,
+            error_cells=self._error_cells_frame,
+            opts=self.opts)
+        return error_model.detect(table, input_name, continuous_columns)
+
+    # -- phase 2 helpers: rule-based repairs ----------------------------------
+
+    def _empty_repaired_cells_frame(self) -> pd.DataFrame:
+        return pd.DataFrame(
+            columns=[self._row_id, "attribute", "current_value", "repaired", ROW_IDX])
+
+    def _repair_by_regexs(self, error_cells_df: pd.DataFrame) \
+            -> Tuple[pd.DataFrame, pd.DataFrame]:
+        regex_detectors = [d for d in self.error_detectors
+                           if isinstance(d, RegExErrorDetector)]
+        if not regex_detectors:
+            return error_cells_df, self._empty_repaired_cells_frame()
+
+        regexs = [(d.attr, d.regex) for d in regex_detectors]
+        _logger.info(f"[Repairing Phase] Repairing data using regexs: {to_list_str(regexs)}")
+
+        repaired_frames = []
+        for attr, regex in regexs:
+            target_cells = error_cells_df[error_cells_df["attribute"] == attr]
+            if len(target_cells) == 0:
+                continue
+            try:
+                repairer = RegexStructureRepair(regex)
+            except Exception as e:
+                _logger.warning(
+                    f"Repairing using regex '{regex}' (attr='{attr}') failed because: {e}")
+                continue
+            repaired = [repairer(cv) if cv is not None else None
+                        for cv in target_cells["current_value"]]
+            fixed = target_cells.assign(repaired=repaired)
+            fixed = fixed[fixed["repaired"].notna()]
+            if len(fixed):
+                repaired_frames.append(fixed)
+
+        if not repaired_frames:
+            return error_cells_df, self._empty_repaired_cells_frame()
+        repaired_cells_df = pd.concat(repaired_frames, ignore_index=True)
+        keys = set(zip(repaired_cells_df[self._row_id], repaired_cells_df["attribute"]))
+        keep = [
+            (r, a) not in keys
+            for r, a in zip(error_cells_df[self._row_id], error_cells_df["attribute"])
+        ]
+        return error_cells_df[keep].reset_index(drop=True), repaired_cells_df
+
+    def _repair_by_nearest_values(self, repair_base_df: pd.DataFrame,
+                                  error_cells_df: pd.DataFrame,
+                                  target_columns: List[str]) \
+            -> Tuple[pd.DataFrame, pd.DataFrame]:
+        assert self.cf is not None
+        cf_targets = self.cf.targets
+        targets = [c for c in target_columns if c in cf_targets] if cf_targets \
+            else target_columns
+        if not targets:
+            return error_cells_df, self._empty_repaired_cells_frame()
+
+        merge_threshold = self._get_option_value(*self._opt_merge_threshold)
+        domains = {
+            c: [str(v) for v in repair_base_df[c].dropna().unique()]
+            for c in targets
+        }
+
+        repaired_rows = []
+        keep_rows = []
+        for _, row in error_cells_df.iterrows():
+            attr = row["attribute"]
+            cur = row["current_value"]
+            dvs = domains.get(attr)
+            if dvs and cur is not None:
+                costs = self.cf.compute_many(cur, dvs)
+                scored = sorted(
+                    ((c, v) for c, v in zip(costs, dvs) if c is not None))
+                if len(scored) >= 2 and scored[0][0] <= merge_threshold \
+                        and scored[0][0] < scored[1][0]:
+                    repaired_rows.append({**row.to_dict(), "repaired": scored[0][1]})
+                    continue
+            keep_rows.append(row)
+
+        repaired_df = pd.DataFrame(repaired_rows) if repaired_rows \
+            else self._empty_repaired_cells_frame()
+        error_df = pd.DataFrame(keep_rows).reset_index(drop=True) if keep_rows \
+            else error_cells_df.iloc[0:0]
+        return error_df, repaired_df
+
+    def _repair_by_rules(self, repair_base_df: pd.DataFrame,
+                         error_cells_df: pd.DataFrame, target_columns: List[str]) \
+            -> Tuple[pd.DataFrame, pd.DataFrame]:
+        repaired_dfs = [self._empty_repaired_cells_frame()]
+        if self._repair_by_regex_enabled:
+            error_cells_df, by_regex = self._repair_by_regexs(error_cells_df)
+            repaired_dfs.append(by_regex)
+        if self._repair_by_nearest_values_enabled:
+            error_cells_df, by_nv = self._repair_by_nearest_values(
+                repair_base_df, error_cells_df, target_columns)
+            repaired_dfs.append(by_nv)
+        repaired_by_rules = pd.concat(repaired_dfs, ignore_index=True)
+        return error_cells_df, repaired_by_rules
+
+    # -- phase 2: model training ----------------------------------------------
+
+    def _select_features(self, pairwise_attr_stats: Dict[str, Any], y: str,
+                         features: List[str]) -> List[str]:
+        """Correlation-ranked feature pruning (reference model.py:677-699)."""
+        max_cols = int(self._get_option_value(*self._opt_max_training_column_num))
+        if max_cols < len(features) and y in pairwise_attr_stats:
+            heap: List[Tuple[float, str]] = []
+            for f, corr in map(tuple, pairwise_attr_stats[y]):
+                if f in features:
+                    heapq.heappush(heap, (float(corr), f))
+            fts = [heapq.heappop(heap) for _ in range(len(heap))]
+            top_k: List[Tuple[float, str]] = []
+            for corr, f in fts:
+                if len(top_k) <= 1 or (float(corr) >= 0.0 and len(top_k) < max_cols):
+                    top_k.append((float(corr), f))
+            _logger.info(
+                "[Repair Model Training Phase] {} features ({}) selected from {} "
+                "features".format(
+                    len(top_k), to_list_str([f"{f}:{c}" for c, f in top_k]),
+                    len(features)))
+            features = [f for _, f in top_k]
+        return features
+
+    def _create_transformers(self, domain_stats: Dict[str, Any],
+                             features: List[str],
+                             continuous_columns: List[str],
+                             is_discrete: bool = True,
+                             num_class: int = 0) -> List[Any]:
+        from delphi_tpu.models.encoding import OrdinalEncoder
+        from delphi_tpu.models.gbdt import gbdt_supported
+        if gbdt_supported(is_discrete, num_class):
+            # tree models consume ordinal codes + raw continuous values,
+            # like the reference's ce.OrdinalEncoder -> LightGBM path
+            return [OrdinalEncoder(features, continuous_columns)]
+        return [FeatureEncoder(features, continuous_columns)]
+
+    def _get_functional_deps(self, train_df: pd.DataFrame,
+                             target_columns: List[str]) \
+            -> Optional[Dict[str, List[str]]]:
+        constraint_detectors = [d for d in self.error_detectors
+                                if isinstance(d, ConstraintErrorDetector)]
+        if len(constraint_detectors) == 1:
+            ced = constraint_detectors[0]
+            constraint_targets = [c for c in target_columns if c in ced.targets] \
+                if ced.targets else target_columns
+            return compute_functional_deps(
+                train_df, ced.constraint_path, ced.constraints, constraint_targets)
+        elif len(constraint_detectors) > 1:
+            _logger.warning(
+                "Multiple constraint classes not supported for detecting functional deps")
+            return None
+        return None
+
+    def _sample_training_data_from(self, df: pd.DataFrame,
+                                   training_data_num: int) -> pd.DataFrame:
+        max_rows = int(self._get_option_value(*self._opt_max_training_row_num))
+        if training_data_num > max_rows:
+            ratio = float(max_rows) / training_data_num
+            _logger.info(
+                f"To reduce training data, extracts {ratio * 100.0}% samples "
+                f"from {training_data_num} rows")
+            return df.sample(frac=ratio, random_state=42)
+        return df
+
+    def _build_repair_stat_models(
+            self, models: Dict[str, Any], train_df: pd.DataFrame,
+            target_columns: List[str], continuous_columns: List[str],
+            num_class_map: Dict[str, int],
+            feature_map: Dict[str, List[str]],
+            transformer_map: Dict[str, List[Any]]) -> Dict[str, Any]:
+        """Builds the remaining per-attribute stat models. The reference's
+        parallel pandas-UDF fan-out (model.py:817-926) is unnecessary here:
+        each jitted trainer already saturates the device, so both the 'series'
+        and 'parallel' settings take this path."""
+        for y in [c for c in target_columns if c not in models]:
+            index = len(models) + 1
+            df = train_df[train_df[y].notna()]
+            training_data_num = len(df)
+            if training_data_num == 0:
+                _logger.info(
+                    "Skipping {}/{} model... type=classfier y={} num_class={}".format(
+                        index, len(target_columns), y, num_class_map[y]))
+                models[y] = (PoorModel(None), feature_map[y], None)
+                continue
+
+            train_pdf = self._sample_training_data_from(df, training_data_num)
+            is_discrete = y not in continuous_columns
+            model_type = "classfier" if is_discrete else "regressor"
+
+            X: Any = train_pdf[feature_map[y]]
+            for transformer in transformer_map[y]:
+                X = transformer.fit_transform(X)
+
+            if is_discrete and self.training_data_rebalancing_enabled:
+                X, y_ = rebalance_training_data(X, train_pdf[y], y)
+            else:
+                y_ = train_pdf[y]
+
+            _logger.info(
+                "Building {}/{} model... type={} y={} features={} #rows={}{}".format(
+                    index, len(target_columns), model_type, y,
+                    to_list_str(feature_map[y]), len(train_pdf),
+                    f" #class={num_class_map[y]}" if num_class_map[y] > 0 else ""))
+            (model, score), elapsed = build_model(
+                X, y_, is_discrete, num_class_map[y], n_jobs=-1, opts=self.opts)
+            if model is None:
+                model = PoorModel(None)
+            _logger.info(
+                f"Finishes building '{y}' model...  score={score} elapsed={elapsed}s")
+            models[y] = (model, feature_map[y], transformer_map[y])
+        return models
+
+    def _resolve_prediction_order(self, models: Dict[str, Any],
+                                  target_columns: List[str]) -> List[Any]:
+        """Orders FD models after the attributes they depend on
+        (reference model.py:928-953)."""
+        pred_ordered_models = []
+        error_columns = copy.deepcopy(target_columns)
+
+        for y in target_columns:
+            (model, x, transformers) = models[y]
+            if not isinstance(model, FunctionalDepModel):
+                pred_ordered_models.append((y, models[y]))
+                error_columns.remove(y)
+
+        while len(error_columns) > 0:
+            columns = copy.deepcopy(error_columns)
+            for y in columns:
+                (model, x, transformers) = models[y]
+                if x[0] not in error_columns:
+                    pred_ordered_models.append((y, models[y]))
+                    error_columns.remove(y)
+            assert len(error_columns) < len(columns)
+
+        _logger.info("Resolved prediction order dependencies: {}".format(
+            to_list_str([x[0] for x in pred_ordered_models])))
+        assert len(pred_ordered_models) == len(target_columns)
+        return pred_ordered_models
+
+    @job_phase(name="repair model training")
+    def _build_repair_models(self, train_df: pd.DataFrame, target_columns: List[str],
+                             continuous_columns: List[str],
+                             domain_stats: Dict[str, Any],
+                             pairwise_attr_stats: Dict[str, Any]) -> List[Any]:
+        # SCARE-style (see reference model.py:959-984): train per-attribute
+        # conditional models P(e_y | clean attrs) on rows whose y is clean;
+        # FD rules substitute for training where a clean attribute determines y.
+        train_df = train_df.drop(columns=[self._row_id])
+
+        functional_deps = self._get_functional_deps(train_df, target_columns) \
+            if self._repair_by_functional_deps_enabled else None
+        if functional_deps:
+            _logger.info(f"Functional deps found: {functional_deps}")
+
+        _logger.info(
+            "[Repair Model Training Phase] Building {} models to repair the cells "
+            "in {}".format(len(target_columns), to_list_str(target_columns)))
+
+        models: Dict[str, Any] = {}
+        num_class_map: Dict[str, int] = {}
+
+        for y in target_columns:
+            index = len(models) + 1
+            input_columns = [c for c in train_df.columns if c != y]
+            is_discrete = y not in continuous_columns
+            num_class_map[y] = int(train_df[y].nunique(dropna=True)) if is_discrete else 0
+
+            if is_discrete and num_class_map[y] <= 1:
+                _logger.info(
+                    "Skipping {}/{} model... type=rule y={} num_class={}".format(
+                        index, len(target_columns), y, num_class_map[y]))
+                non_null = train_df[y].dropna()
+                v = non_null.iloc[0] if num_class_map[y] == 1 and len(non_null) else None
+                models[y] = (PoorModel(v), input_columns, None)
+
+            if y not in models and functional_deps is not None and y in functional_deps:
+                max_domain = int(self._get_option_value(*self._opt_max_domain_size))
+                fx = [x for x in functional_deps[y]
+                      if int(domain_stats[x]) < max_domain]
+                if len(fx) > 0:
+                    _logger.info(
+                        "Building {}/{} model... type=rule(FD: X->y)  y={}(|y|={}) "
+                        "X={}(|X|={})".format(
+                            index, len(target_columns), y, num_class_map[y],
+                            fx[0], domain_stats[fx[0]]))
+                    fd_map = compute_functional_dep_map(train_df, fx[0], y)
+                    models[y] = (FunctionalDepModel(fx[0], fd_map), [fx[0]], None)
+
+        if len(models) != len(target_columns):
+            feature_map: Dict[str, List[str]] = {}
+            transformer_map: Dict[str, List[Any]] = {}
+            for y in [c for c in target_columns if c not in models]:
+                input_columns = [c for c in train_df.columns if c != y]
+                features = self._select_features(pairwise_attr_stats, y, input_columns)
+                feature_map[y] = features
+                transformer_map[y] = self._create_transformers(
+                    domain_stats, features, continuous_columns,
+                    is_discrete=y not in continuous_columns,
+                    num_class=num_class_map[y])
+            models = self._build_repair_stat_models(
+                models, train_df, target_columns, continuous_columns,
+                num_class_map, feature_map, transformer_map)
+
+        assert len(models) == len(target_columns)
+
+        if any(isinstance(m, FunctionalDepModel) for m, _, _ in models.values()):
+            return self._resolve_prediction_order(models, target_columns)
+        return list(models.items())
+
+    # -- phase 3: repair -------------------------------------------------------
+
+    @job_phase(name="repairing")
+    def _repair(self, models: List[Any], continuous_columns: List[str],
+                dirty_rows_df: pd.DataFrame, error_cells_df: pd.DataFrame,
+                compute_repair_candidate_prob: bool,
+                maximal_likelihood_repair: bool) -> pd.DataFrame:
+        """Batched repair inference: for each model (in dependency order)
+        predict the NULL cells of its target column over the whole dirty-row
+        block at once (replaces the grouped-map repair UDF,
+        reference model.py:1062-1143)."""
+        _logger.info(
+            f"[Repairing Phase] Computing {len(error_cells_df)} repair updates in "
+            f"{len(dirty_rows_df)} rows...")
+
+        integral_columns = {
+            c: True for c in dirty_rows_df.columns
+            if pd.api.types.is_integer_dtype(dirty_rows_df[c].dtype)}
+        need_pmf = compute_repair_candidate_prob or maximal_likelihood_repair
+
+        pdf = dirty_rows_df.reset_index(drop=True).copy()
+        for y, (model, features, transformers) in models:
+            X: Any = pdf[features]
+            if transformers:
+                for transformer in transformers:
+                    X = transformer.transform(X)
+
+            missing = pdf[y].isna()
+            if need_pmf and y not in continuous_columns:
+                predicted = model.predict_proba(X)
+
+                def _to_pmf(probs: Any) -> Dict[str, Any]:
+                    if probs is None:
+                        return {"classes": [], "probs": []}
+                    return {"classes": [str(c) for c in model.classes_.tolist()],
+                            "probs": list(map(float, probs))}
+
+                pmf = [_to_pmf(p) for p in predicted]
+                filled = pdf[y].astype(object)
+                filled[missing] = [pmf[i] for i in np.nonzero(missing.to_numpy())[0]]
+                pdf[y] = filled
+            else:
+                predicted = np.asarray(model.predict(X), dtype=object)
+                if y in integral_columns:
+                    num = pd.to_numeric(pd.Series(predicted), errors="coerce")
+                    predicted = np.round(num.to_numpy()).astype(np.float64)
+                    filled = pdf[y].astype("float64")
+                    filled[missing] = predicted[missing.to_numpy()]
+                    pdf[y] = filled
+                else:
+                    filled = pdf[y].astype(object) \
+                        if not pd.api.types.is_float_dtype(pdf[y]) else pdf[y].copy()
+                    filled[missing] = predicted[missing.to_numpy()]
+                    pdf[y] = filled
+        return pdf
+
+    def _flatten(self, df: pd.DataFrame) -> pd.DataFrame:
+        """(row_id, attribute, value) long view (RepairMiscApi.scala:41-49);
+        values keep their python objects (PMF dicts pass through)."""
+        records = []
+        cols = [c for c in df.columns if c != self._row_id]
+        for _, row in df.iterrows():
+            for c in cols:
+                v = row[c]
+                if v is not None and not isinstance(v, dict) and pd.isna(v):
+                    v = None
+                elif isinstance(v, (int, np.integer)):
+                    v = str(int(v))
+                elif isinstance(v, (float, np.floating)):
+                    v = str(float(v))
+                elif not isinstance(v, dict) and v is not None:
+                    v = str(v)
+                records.append((row[self._row_id], c, v))
+        return pd.DataFrame(records, columns=[self._row_id, "attribute", "value"])
+
+    def _compute_weighted_probs(self, pmf_rows: List[Dict[str, Any]]) \
+            -> List[Dict[str, Any]]:
+        assert self.cf is not None
+        weight = float(self._get_option_value(*self._opt_cost_weight))
+        cf_targets = set(self.cf.targets)
+        if cf_targets:
+            _logger.info(f"[Repairing Phase] {self.cf} computing weighting probs...")
+        for rec in pmf_rows:
+            if cf_targets and rec["attribute"] not in cf_targets:
+                continue
+            costs = self.cf.compute_many(rec["current_value"], rec["classes"])
+            if costs is not None:
+                rec["probs"] = [
+                    p * (1.0 / (1.0 + weight * c)) if c is not None else p
+                    for p, c in zip(rec["probs"], costs)]
+            total = sum(rec["probs"])
+            if total > 0:
+                rec["probs"] = [p / total for p in rec["probs"]]
+        return pmf_rows
+
+    def _compute_repair_pmf(self, repaired_rows_df: pd.DataFrame,
+                            error_cells_df: pd.DataFrame,
+                            continuous_columns: List[str]) -> pd.DataFrame:
+        """PMF extraction + cost weighting + top-k filtering
+        (reference model.py:1174-1225)."""
+        flat = self._flatten(repaired_rows_df)
+        keys = error_cells_df[[self._row_id, "attribute", "current_value"]]
+        joined = flat.merge(keys, on=[self._row_id, "attribute"], how="inner")
+
+        continuous = set(continuous_columns)
+        discrete = joined[~joined["attribute"].isin(continuous)]
+        pmf_rows: List[Dict[str, Any]] = []
+        for _, row in discrete.iterrows():
+            v = row["value"]
+            classes, probs = (v.get("classes", []), v.get("probs", [])) \
+                if isinstance(v, dict) else ([], [])
+            pmf_rows.append({
+                self._row_id: row[self._row_id],
+                "attribute": row["attribute"],
+                "current_value": row["current_value"],
+                "classes": list(classes),
+                "probs": list(probs)[: len(classes)],
+            })
+
+        if self.cf is not None:
+            pmf_rows = self._compute_weighted_probs(pmf_rows)
+
+        threshold = self._get_option_value(*self._opt_prob_threshold)
+        top_k = self._get_option_value(*self._opt_prob_top_k)
+
+        out = []
+        for rec in pmf_rows:
+            cur = rec["current_value"]
+            cur_prob = 0.0
+            for c, p in zip(rec["classes"], rec["probs"]):
+                if c == cur:
+                    cur_prob = p
+                    break
+            pmf = sorted(
+                ({"class": c, "prob": p} for c, p in zip(rec["classes"], rec["probs"])),
+                key=lambda e: -e["prob"])
+            pmf = [e for e in pmf if e["prob"] > threshold][:top_k]
+            out.append({
+                self._row_id: rec[self._row_id],
+                "attribute": rec["attribute"],
+                "current_value": {"value": cur, "prob": cur_prob},
+                "pmf": pmf,
+            })
+
+        if continuous:
+            cont = joined[joined["attribute"].isin(continuous)]
+            for _, row in cont.iterrows():
+                out.append({
+                    self._row_id: row[self._row_id],
+                    "attribute": row["attribute"],
+                    "current_value": {"value": row["current_value"], "prob": 0.0},
+                    "pmf": [{"class": row["value"], "prob": 1.0}],
+                })
+
+        pmf_df = pd.DataFrame(
+            out, columns=[self._row_id, "attribute", "current_value", "pmf"])
+        assert len(pmf_df) == len(error_cells_df)
+        return pmf_df
+
+    def _compute_score(self, pmf_df: pd.DataFrame) -> pd.DataFrame:
+        """Log-likelihood-ratio x cost-discount score (reference
+        model.py:1227-1248)."""
+        assert self.cf is not None
+        rows = []
+        for _, row in pmf_df.iterrows():
+            pmf = row["pmf"]
+            repaired = pmf[0] if pmf else {"class": None, "prob": 1e-6}
+            cur = row["current_value"]
+            base = cur["value"] if cur["value"] is not None else repaired["class"]
+            cost = self.cf.compute(base, repaired["class"])
+            cur_prob = cur["prob"] if cur["prob"] > 0.0 else 1e-6
+            score = np.log(max(repaired["prob"], 1e-300) / cur_prob) * \
+                (1.0 / (1.0 + (cost if cost is not None else 256.0)))
+            rows.append({
+                self._row_id: row[self._row_id],
+                "attribute": row["attribute"],
+                "current_value": cur["value"],
+                "repaired": repaired["class"],
+                "score": float(score),
+            })
+        return pd.DataFrame(
+            rows, columns=[self._row_id, "attribute", "current_value", "repaired", "score"])
+
+    def _maximal_likelihood_repair(self, score_df: pd.DataFrame,
+                                   error_cells_df: pd.DataFrame) -> pd.DataFrame:
+        """Keeps the top `repair_delta` updates by score percentile
+        (reference model.py:1259-1277)."""
+        assert self.repair_delta is not None
+        num_error_cells = len(error_cells_df)
+        percent = min(1.0, 1.0 - self.repair_delta / num_error_cells)
+        thres = float(np.percentile(score_df["score"].to_numpy(), percent * 100.0)) \
+            if len(score_df) else 0.0
+        top = score_df[score_df["score"] >= thres].drop(columns=["score"])
+        _logger.info(
+            "[Repairing Phase] {} repair updates (delta={}) selected among {} "
+            "candidates".format(len(top), self.repair_delta, num_error_cells))
+        return top.reset_index(drop=True)
+
+    def _continuous_kind_map(self, table: EncodedTable) -> Dict[str, str]:
+        return {c.name: c.kind for c in table.columns if c.is_numeric}
+
+    def _repair_attrs(self, repair_updates: Union[str, pd.DataFrame],
+                      base_table: Union[str, pd.DataFrame],
+                      table: EncodedTable) -> pd.DataFrame:
+        updates = repair_updates if type(repair_updates) is pd.DataFrame \
+            else self._session.table(str(repair_updates))
+        base = base_table if type(base_table) is pd.DataFrame \
+            else self._session.table(str(base_table))
+        return repair_attrs_from(updates, base, self._row_id,
+                                 self._continuous_kind_map(table))
+
+    @job_phase(name="validating")
+    def _validate_repairs(self, repair_candidates: pd.DataFrame,
+                          clean_rows: pd.DataFrame) -> pd.DataFrame:
+        _logger.info("[Validation Phase] Validating {} repair candidates...".format(
+            len(repair_candidates)))
+        return repair_candidates
+
+    # -- run ------------------------------------------------------------------
+
+    @elapsed_time  # type: ignore
+    def _run(self, table: EncodedTable, input_name: str,
+             continuous_columns: List[str], detect_errors_only: bool,
+             compute_repair_candidate_prob: bool, compute_repair_prob: bool,
+             compute_repair_score: bool, repair_data: bool,
+             maximal_likelihood_repair: bool) -> pd.DataFrame:
+        #######################################################################
+        # 1. Error Detection Phase
+        #######################################################################
+        _logger.info(
+            f"[Error Detection Phase] Detecting errors in a table `{input_name}`... ")
+        error_cells_df, target_columns, pairwise_attr_stats, domain_stats = \
+            self._detect_errors(table, input_name, continuous_columns)
+
+        if detect_errors_only:
+            return error_cells_df.drop(columns=[ROW_IDX], errors="ignore")
+
+        if len(error_cells_df) == 0:
+            _logger.info("Any error cell not found, so the input data is already clean")
+            if repair_data:
+                return table.to_pandas()
+            return pd.DataFrame(
+                columns=[self._row_id, "attribute", "current_value"])
+
+        if len(target_columns) == 0:
+            raise ValueError(
+                "At least one valid discretizable feature is needed to repair error "
+                "cells, but no such feature found")
+
+        error_cells_df = self._filter_columns_from(error_cells_df, target_columns)
+
+        #######################################################################
+        # 2. Repair Model Training Phase
+        #######################################################################
+        masked = table.with_nulls_at(
+            list(zip(error_cells_df[ROW_IDX].astype(int), error_cells_df["attribute"])))
+        repair_base_df = masked.to_pandas()
+
+        repaired_by_rules_df = None
+        if self.repair_by_rules:
+            error_cells_df, repaired_by_rules_df = self._repair_by_rules(
+                repair_base_df, error_cells_df, target_columns)
+            repair_base_df = repair_attrs_from(
+                repaired_by_rules_df, repair_base_df, self._row_id,
+                self._continuous_kind_map(table))
+
+        error_row_ids = set(error_cells_df[self._row_id])
+        is_dirty = repair_base_df[self._row_id].isin(error_row_ids)
+        clean_rows_df = repair_base_df[~is_dirty]
+        dirty_rows_df = repair_base_df[is_dirty]
+
+        models = self._build_repair_models(
+            repair_base_df, target_columns, continuous_columns,
+            domain_stats, pairwise_attr_stats)
+
+        #######################################################################
+        # 3. Repair Phase
+        #######################################################################
+        repaired_rows_df = self._repair(
+            models, continuous_columns, dirty_rows_df, error_cells_df,
+            compute_repair_candidate_prob, maximal_likelihood_repair)
+
+        if compute_repair_candidate_prob and not maximal_likelihood_repair:
+            assert not self._repair_by_nearest_values_enabled, \
+                "repairing data by nearest values not supported in this path"
+            pmf_df = self._compute_repair_pmf(
+                repaired_rows_df, error_cells_df, continuous_columns)
+            pmf_df = pmf_df.assign(
+                current_value=[cv["value"] for cv in pmf_df["current_value"]])
+            if compute_repair_prob:
+                return pd.DataFrame({
+                    self._row_id: pmf_df[self._row_id],
+                    "attribute": pmf_df["attribute"],
+                    "current_value": pmf_df["current_value"],
+                    "repaired": [p[0]["class"] if p else None for p in pmf_df["pmf"]],
+                    "prob": [p[0]["prob"] if p else None for p in pmf_df["pmf"]],
+                })
+            return pmf_df
+
+        if maximal_likelihood_repair:
+            assert len(continuous_columns) == 0
+            assert len(self.cf.targets) == 0  # type: ignore
+            assert not self._repair_by_nearest_values_enabled, \
+                "repairing data by nearest values not supported in this path"
+
+            pmf_df = self._compute_repair_pmf(repaired_rows_df, error_cells_df, [])
+            score_df = self._compute_score(pmf_df)
+            if compute_repair_score:
+                return score_df
+
+            top_delta_repairs_df = self._maximal_likelihood_repair(
+                score_df, error_cells_df)
+            if not repair_data:
+                return top_delta_repairs_df
+            repaired_rows_df = self._repair_attrs(
+                top_delta_repairs_df, dirty_rows_df, table)
+
+        if repair_data:
+            clean_df = pd.concat([clean_rows_df, repaired_rows_df], ignore_index=True)
+            assert len(clean_df) == table.n_rows
+            return clean_df
+
+        flat = self._flatten(repaired_rows_df)
+        repair_candidates_df = flat.merge(
+            error_cells_df[[self._row_id, "attribute", "current_value"]],
+            on=[self._row_id, "attribute"], how="inner") \
+            .rename(columns={"value": "repaired"})
+        repair_candidates_df = repair_candidates_df[
+            [self._row_id, "attribute", "current_value", "repaired"]]
+        changed = [
+            (r is None) or not _null_safe_eq(c, r)
+            for c, r in zip(repair_candidates_df["current_value"],
+                            repair_candidates_df["repaired"])]
+        repair_candidates_df = repair_candidates_df[changed].reset_index(drop=True)
+
+        if self.repair_by_rules and repaired_by_rules_df is not None \
+                and len(repaired_by_rules_df):
+            extra = repaired_by_rules_df[
+                [self._row_id, "attribute", "current_value", "repaired"]]
+            repair_candidates_df = pd.concat(
+                [repair_candidates_df, extra], ignore_index=True)
+        if self.repair_validation_enabled:
+            repair_candidates_df = self._validate_repairs(
+                repair_candidates_df, clean_rows_df)
+        return repair_candidates_df
+
+    def _check_input_table(self) -> Tuple[EncodedTable, str, List[str]]:
+        df, input_name = self._input_frame
+        table, continuous_columns = check_input_table(df, self._row_id, input_name)
+        _logger.info("input_table: {} ({} rows x {} columns)".format(
+            input_name, table.n_rows, len(table.columns)))
+        return table, input_name, continuous_columns
+
+    def run(self, detect_errors_only: bool = False,
+            compute_repair_candidate_prob: bool = False,
+            compute_repair_prob: bool = False, compute_repair_score: bool = False,
+            repair_data: bool = False,
+            maximal_likelihood_repair: bool = False) -> pd.DataFrame:
+        """Runs the pipeline; flag semantics identical to the reference
+        (model.py:1421-1537)."""
+        if self.input is None or self.row_id is None:
+            raise ValueError("`setInput` and `setRowId` should be called before repairing")
+
+        if maximal_likelihood_repair and self.repair_delta is None:
+            raise ValueError(
+                "`setRepairDelta` should be called when enabling "
+                "maximal likelihood repairing")
+        if maximal_likelihood_repair and self.cf is None:
+            raise ValueError(
+                "`setUpdateCostFunction` should be called when enabling "
+                "maximal likelihood repairing")
+        if maximal_likelihood_repair and len(self.cf.targets) > 0:  # type: ignore
+            raise ValueError(
+                "`UpdateCostFunction.targets` cannot be used when enabling "
+                "maximal likelihood repairing")
+
+        exclusive_params = [
+            ("detect_errors_only", detect_errors_only),
+            ("compute_repair_candidate_prob", compute_repair_candidate_prob),
+            ("compute_repair_prob", compute_repair_prob),
+            ("compute_repair_score", compute_repair_score),
+            ("repair_data", repair_data),
+        ]
+        selected = [name for name, value in exclusive_params if value]
+        if len(selected) > 1:
+            raise ValueError("{} cannot be set to true simultaneously".format(
+                to_list_str(selected, sep="/", quote=True)))
+
+        if self._repair_by_nearest_values_enabled and \
+                (maximal_likelihood_repair or compute_repair_candidate_prob or
+                 compute_repair_prob or compute_repair_score):
+            raise ValueError(
+                "Cannot repair data by nearest values when enabling "
+                "`maximal_likelihood_repair`, `compute_repair_candidate_prob`, "
+                "`compute_repair_prob`, or `compute_repair_score`")
+
+        if compute_repair_prob or compute_repair_score:
+            compute_repair_candidate_prob = True
+        if compute_repair_score:
+            maximal_likelihood_repair = True
+
+        table, input_name, continuous_columns = self._check_input_table()
+
+        if maximal_likelihood_repair and len(continuous_columns) != 0:
+            raise ValueError(
+                "Cannot enable the maximal likelihood repair mode "
+                "when continous attributes found")
+
+        if self.targets and \
+                len(set(self.targets) & set(table.column_names)) == 0:
+            raise ValueError(
+                f"Target attributes not found in {input_name}: "
+                f"{to_list_str(self.targets)}")
+
+        df, elapsed = self._run(
+            table, input_name, continuous_columns, detect_errors_only,
+            compute_repair_candidate_prob, compute_repair_prob,
+            compute_repair_score, repair_data, maximal_likelihood_repair)
+        _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
+        return df
+
+
+def _null_safe_eq(a: Any, b: Any) -> bool:
+    a_null = a is None or (not isinstance(a, (list, dict)) and pd.isna(a))
+    b_null = b is None or (not isinstance(b, (list, dict)) and pd.isna(b))
+    if a_null or b_null:
+        return a_null and b_null
+    return str(a) == str(b)

@@ -1,0 +1,10 @@
+"""Repair model families (JAX): feature encoding, linear/MLP heads, GBDT.
+
+These replace the reference's LightGBM + hyperopt training stack
+(`python/repair/train.py:89-229`) with jitted JAX models that keep the same
+scikit-learn-like duck type (``classes_``, ``predict``, ``predict_proba``)
+expected by the repair pipeline (reference model.py:44-100).
+"""
+
+from delphi_tpu.models.encoding import FeatureEncoder
+from delphi_tpu.models.linear import LogisticRegressionModel, MLPRegressorModel

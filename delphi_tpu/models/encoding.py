@@ -1,0 +1,110 @@
+"""Feature encoding for repair models.
+
+Replaces the reference's category_encoders SumEncoder/OrdinalEncoder split
+(`model.py:701-729`) with a single encoder that one-hot encodes discrete
+features (with a dedicated unknown/NULL slot — `handle_unknown='impute'`
+behavior) and standardizes continuous ones (NaN -> mean, i.e. 0 after
+standardization). The output is a dense float32 design matrix, the natural
+input layout for MXU matmuls.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class FeatureEncoder:
+    """fit/transform over pandas feature frames -> float32 [n, D]."""
+
+    def __init__(self, features: Sequence[str], continuous: Sequence[str],
+                 max_onehot: int = 256) -> None:
+        self.features = list(features)
+        self.continuous = [c for c in continuous if c in self.features]
+        self.max_onehot = max_onehot
+        self._vocab: Dict[str, Dict[str, int]] = {}
+        self._mean: Dict[str, float] = {}
+        self._std: Dict[str, float] = {}
+        self.n_dims = 0
+        self._fitted = False
+
+    def fit(self, X: pd.DataFrame) -> "FeatureEncoder":
+        self.n_dims = 0
+        for f in self.features:
+            if f in self.continuous:
+                v = pd.to_numeric(X[f], errors="coerce").to_numpy(dtype=np.float64)
+                mean = float(np.nanmean(v)) if np.isfinite(v).any() else 0.0
+                std = float(np.nanstd(v))
+                self._mean[f] = mean
+                self._std[f] = std if std > 0 else 1.0
+                self.n_dims += 1
+            else:
+                values = X[f].dropna().astype(str)
+                counts = values.value_counts()
+                vocab = {v: i for i, v in enumerate(counts.index[: self.max_onehot])}
+                self._vocab[f] = vocab
+                self.n_dims += len(vocab) + 1  # +1 unknown/NULL slot
+        self._fitted = True
+        return self
+
+    def transform(self, X: pd.DataFrame) -> np.ndarray:
+        assert self._fitted, "fit() must be called before transform()"
+        n = len(X)
+        out = np.zeros((n, self.n_dims), dtype=np.float32)
+        d = 0
+        for f in self.features:
+            if f in self.continuous:
+                v = pd.to_numeric(X[f], errors="coerce").to_numpy(dtype=np.float64)
+                v = (v - self._mean[f]) / self._std[f]
+                out[:, d] = np.where(np.isnan(v), 0.0, v).astype(np.float32)
+                d += 1
+            else:
+                vocab = self._vocab[f]
+                width = len(vocab) + 1
+                idx = X[f].map(
+                    lambda v: vocab.get(str(v), len(vocab)) if pd.notna(v) else len(vocab)
+                ).to_numpy(dtype=np.int64)
+                out[np.arange(n), d + idx] = 1.0
+                d += width
+        return out
+
+    def fit_transform(self, X: pd.DataFrame) -> np.ndarray:
+        return self.fit(X).transform(X)
+
+
+class OrdinalEncoder:
+    """Discrete values -> ordinal codes (unknown/NULL -> -1), continuous kept
+    raw. The bin-friendly layout used by the GBDT models."""
+
+    def __init__(self, features: Sequence[str], continuous: Sequence[str]) -> None:
+        self.features = list(features)
+        self.continuous = [c for c in continuous if c in self.features]
+        self._vocab: Dict[str, Dict[str, int]] = {}
+        self._fitted = False
+
+    def fit(self, X: pd.DataFrame) -> "OrdinalEncoder":
+        for f in self.features:
+            if f not in self.continuous:
+                values = X[f].dropna().astype(str).unique()
+                self._vocab[f] = {v: i for i, v in enumerate(values)}
+        self._fitted = True
+        return self
+
+    def transform(self, X: pd.DataFrame) -> np.ndarray:
+        assert self._fitted
+        cols = []
+        for f in self.features:
+            if f in self.continuous:
+                cols.append(pd.to_numeric(X[f], errors="coerce")
+                            .to_numpy(dtype=np.float64))
+            else:
+                vocab = self._vocab[f]
+                codes = X[f].map(
+                    lambda v: vocab.get(str(v), -1) if pd.notna(v) else -1
+                ).to_numpy(dtype=np.float64)
+                codes[codes < 0] = np.nan
+                cols.append(codes)
+        return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0))
+
+    def fit_transform(self, X: pd.DataFrame) -> np.ndarray:
+        return self.fit(X).transform(X)

@@ -1,0 +1,201 @@
+"""Jitted linear / MLP repair-model heads.
+
+The classifier is a multinomial logistic regression over one-hot features and
+the regressor a small MLP — both trained full-batch with optax.adam inside a
+``lax.scan`` so the whole optimization compiles to a single XLA program (no
+per-step Python). Rows are padded to the next power of two to bound XLA
+recompilation across the per-attribute model loop.
+
+They expose the scikit-learn-like duck type (``classes_`` / ``predict`` /
+``predict_proba``) that the repair pipeline expects (reference
+model.py:44-100, train.py:232-234).
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+
+
+def _pad_rows(X: np.ndarray, *arrays: np.ndarray):
+    n = X.shape[0]
+    padded = max(8, 1 << (n - 1).bit_length())
+    if padded == n:
+        mask = np.ones(n, dtype=np.float32)
+        return X, arrays, mask
+    pad = padded - n
+    Xp = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)], axis=0)
+    outs = tuple(np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+                 for a in arrays)
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    return Xp, outs, mask
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps):
+    n, d = X.shape
+    k = class_weights.shape[0]
+    W = jnp.zeros((d, k), dtype=jnp.float32)
+    b = jnp.zeros((k,), dtype=jnp.float32)
+    opt = optax.adam(lr)
+    state = opt.init((W, b))
+    sample_w = mask * class_weights[y]
+    denom = jnp.maximum(sample_w.sum(), 1.0)
+
+    def loss_fn(params):
+        W, b = params
+        logits = X @ W + b
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        return (sample_w * nll).sum() / denom + l2 * jnp.sum(W * W)
+
+    def step(carry, _):
+        params, state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state)
+        params = optax.apply_updates(params, updates)
+        return (params, state), loss
+
+    (params, _), losses = jax.lax.scan(step, ((W, b), state), None, length=n_steps)
+    return params, losses[-1]
+
+
+@partial(jax.jit, static_argnames=("n_steps", "hidden"))
+def _fit_mlp_regressor(X, y, mask, l2, lr, n_steps, hidden, seed):
+    n, d = X.shape
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
+        "w1": jax.random.normal(k1, (d, hidden), jnp.float32) * jnp.sqrt(2.0 / max(d, 1)),
+        "b1": jnp.zeros((hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (hidden, hidden), jnp.float32) * jnp.sqrt(2.0 / hidden),
+        "b2": jnp.zeros((hidden,), jnp.float32),
+        "w3": jax.random.normal(k3, (hidden, 1), jnp.float32) * jnp.sqrt(2.0 / hidden),
+        "b3": jnp.zeros((1,), jnp.float32),
+    }
+    opt = optax.adam(lr)
+    state = opt.init(params)
+    denom = jnp.maximum(mask.sum(), 1.0)
+
+    def forward(p, X):
+        h = jax.nn.relu(X @ p["w1"] + p["b1"])
+        h = jax.nn.relu(h @ p["w2"] + p["b2"])
+        return (h @ p["w3"] + p["b3"])[:, 0]
+
+    def loss_fn(p):
+        pred = forward(p, X)
+        mse = (mask * (pred - y) ** 2).sum() / denom
+        reg = sum(jnp.sum(p[k] ** 2) for k in ("w1", "w2", "w3"))
+        return mse + l2 * reg
+
+    def step(carry, _):
+        p, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s)
+        p = optax.apply_updates(p, updates)
+        return (p, s), loss
+
+    (params, _), losses = jax.lax.scan(step, (params, state), None, length=n_steps)
+    return params, losses[-1]
+
+
+@jax.jit
+def _mlp_forward(params, X):
+    h = jax.nn.relu(X @ params["w1"] + params["b1"])
+    h = jax.nn.relu(h @ params["w2"] + params["b2"])
+    return (h @ params["w3"] + params["b3"])[:, 0]
+
+
+class LogisticRegressionModel:
+    """Multinomial logistic regression with balanced class weights (the
+    reference trains LightGBM with class_weight='balanced', train.py:105)."""
+
+    def __init__(self, n_steps: int = 300, lr: float = 0.2, l2: float = 1e-4) -> None:
+        self.n_steps = n_steps
+        self.lr = lr
+        self.l2 = l2
+        self._params: Optional[Any] = None
+        self._classes: Optional[np.ndarray] = None
+        self.loss_: float = 0.0
+
+    @property
+    def classes_(self) -> np.ndarray:
+        assert self._classes is not None
+        return self._classes
+
+    def fit(self, X: np.ndarray, y: "pd.Series") -> "LogisticRegressionModel":
+        codes, classes = pd.factorize(np.asarray(y), sort=True)
+        assert (codes >= 0).all(), "y must not contain NULLs"
+        self._classes = np.asarray(classes)
+        k = len(classes)
+        counts = np.bincount(codes, minlength=k).astype(np.float32)
+        class_weights = len(codes) / (k * np.maximum(counts, 1.0))
+
+        Xp, (yp,), mask = _pad_rows(np.asarray(X, np.float32),
+                                    codes.astype(np.int32))
+        params, loss = _fit_logreg(
+            jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
+            jnp.asarray(class_weights), self.l2, self.lr, self.n_steps)
+        self._params = jax.device_get(params)
+        self.loss_ = float(loss)
+        return self
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        assert self._params is not None
+        W, b = self._params
+        logits = np.asarray(X, np.float32) @ W + b
+        logits -= logits.max(axis=1, keepdims=True)
+        e = np.exp(logits)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        probs = self.predict_proba(X)
+        return self.classes_[probs.argmax(axis=1)]
+
+
+class MLPRegressorModel:
+    """Small MLP regressor with standardized targets."""
+
+    def __init__(self, n_steps: int = 500, lr: float = 0.01, l2: float = 1e-5,
+                 hidden: int = 64, seed: int = 42) -> None:
+        self.n_steps = n_steps
+        self.lr = lr
+        self.l2 = l2
+        self.hidden = hidden
+        self.seed = seed
+        self._params: Optional[Any] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+        self.loss_: float = 0.0
+
+    @property
+    def classes_(self) -> np.ndarray:
+        return np.array([])
+
+    def fit(self, X: np.ndarray, y: "pd.Series") -> "MLPRegressorModel":
+        yv = pd.to_numeric(pd.Series(np.asarray(y)), errors="coerce") \
+            .to_numpy(dtype=np.float64)
+        assert not np.isnan(yv).any(), "y must not contain NULLs"
+        self._y_mean = float(yv.mean())
+        self._y_std = float(yv.std()) or 1.0
+        yn = ((yv - self._y_mean) / self._y_std).astype(np.float32)
+
+        Xp, (yp,), mask = _pad_rows(np.asarray(X, np.float32), yn)
+        params, loss = _fit_mlp_regressor(
+            jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
+            self.l2, self.lr, self.n_steps, self.hidden, self.seed)
+        self._params = params
+        self.loss_ = float(loss)
+        return self
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        assert self._params is not None
+        pred = np.asarray(_mlp_forward(self._params, jnp.asarray(X, dtype=jnp.float32)))
+        return pred * self._y_std + self._y_mean
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError("regressors have no probability output")

@@ -1,0 +1,79 @@
+"""Row-clustering kernels for input splitting.
+
+Replaces the reference's CountVectorizer bag-of-q-grams + Spark MLlib
+(Bisecting)KMeans (`RepairMiscApi.scala:104-152`) with a hashed q-gram bag
+(fixed feature dimension, so shapes stay static for XLA) and a jitted Lloyd's
+k-means over the device.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+FEATURE_DIM = 1024
+
+
+def _qgrams(value: str, q: int):
+    if len(value) > q:
+        for i in range(len(value) - q + 1):
+            yield value[i:i + q]
+    else:
+        yield value
+
+
+def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
+    """Hashed bag-of-q-grams over the row's string values
+    (RepairMiscApi.scala:52-71 computes exact q-grams; we hash to a fixed
+    dimension which preserves the clustering geometry)."""
+    assert q > 0, f"`q` must be positive, but {q} got"
+    n = len(df)
+    out = np.zeros((n, FEATURE_DIM), dtype=np.float32)
+    cols = [df[c].map(lambda v: None if pd.isna(v) else str(v)) for c in df.columns]
+    for i in range(n):
+        for col in cols:
+            v = col.iloc[i]
+            if v is None:
+                continue
+            for g in _qgrams(v, q):
+                out[i, hash(g) % FEATURE_DIM] += 1.0
+    return out
+
+
+@partial(jax.jit, static_argnames=("k", "n_iters"))
+def _kmeans_jax(X: jnp.ndarray, init: jnp.ndarray, k: int, n_iters: int) -> jnp.ndarray:
+    def step(centers, _):
+        d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        labels = d.argmin(axis=1)
+        one_hot = jax.nn.one_hot(labels, k, dtype=X.dtype)
+        counts = one_hot.sum(0)
+        sums = one_hot.T @ X
+        new_centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts[:, None], 1.0), centers)
+        return new_centers, None
+
+    centers, _ = jax.lax.scan(step, init, None, length=n_iters)
+    d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    return d.argmin(axis=1)
+
+
+def kmeans(X: np.ndarray, k: int, seed: int = 0, n_iters: int = 20) -> np.ndarray:
+    """Lloyd's k-means with distance-weighted (k-means++-style) seeding."""
+    n = X.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    k = min(k, n)
+    rng = np.random.RandomState(seed)
+    centers = [X[rng.randint(n)]]
+    for _ in range(1, k):
+        d = np.min([((X - c) ** 2).sum(-1) for c in centers], axis=0)
+        total = d.sum()
+        if total <= 0:
+            centers.append(X[rng.randint(n)])
+        else:
+            centers.append(X[rng.choice(n, p=d / total)])
+    init = jnp.asarray(np.stack(centers))
+    labels = _kmeans_jax(jnp.asarray(X), init, k, n_iters)
+    return np.asarray(labels, dtype=np.int64)

@@ -1,0 +1,169 @@
+"""Repair-model training: option registry, model dispatch, class rebalancing.
+
+API-compatible port of the reference's `python/repair/train.py` surface
+(`build_model`, `rebalance_training_data`, `compute_class_nrow_stdv`,
+`train_option_keys`): the LightGBM + hyperopt stack is replaced by jitted JAX
+models (see :mod:`delphi_tpu.models`). The `model.lgb.*` / `model.cv.*` /
+`model.hp.*` option keys are preserved so reference configurations keep
+validating; the applicable ones map onto the JAX trainers
+(learning_rate -> optimizer lr, n_estimators -> boosting rounds / step budget,
+max_depth -> tree depth).
+"""
+
+from collections import namedtuple
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu.utils import elapsed_time, get_option_value, setup_logger
+
+_logger = setup_logger()
+
+_option = namedtuple("_option", "key default_value type_class validator err_msg")
+
+_opt_boosting_type = \
+    _option("model.lgb.boosting_type", "gbdt", str,
+            lambda v: v in ["gbdt", "dart", "goss", "rf"],
+            "`{}` should be in ['gbdt', 'dart', 'goss', 'rf']")
+_opt_class_weight = \
+    _option("model.lgb.class_weight", "balanced", str, None, None)
+_opt_learning_rate = \
+    _option("model.lgb.learning_rate", 0.01, float,
+            lambda v: v > 0.0, "`{}` should be positive")
+_opt_max_depth = \
+    _option("model.lgb.max_depth", 7, int, None, None)
+_opt_max_bin = \
+    _option("model.lgb.max_bin", 255, int, None, None)
+_opt_reg_alpha = \
+    _option("model.lgb.reg_alpha", 0.0, float,
+            lambda v: v >= 0.0, "`{}` should be greater than or equal to 0.0")
+_opt_min_split_gain = \
+    _option("model.lgb.min_split_gain", 0.0, float,
+            lambda v: v >= 0.0, "`{}` should be greater than or equal to 0.0")
+_opt_n_estimators = \
+    _option("model.lgb.n_estimators", 300, int,
+            lambda v: v > 0, "`{}` should be positive")
+_opt_importance_type = \
+    _option("model.lgb.importance_type", "gain", str,
+            lambda v: v in ["split", "gain"], "`{}` should be in ['split', 'gain']")
+_opt_n_splits = \
+    _option("model.cv.n_splits", 3, int,
+            lambda v: v >= 3, "`{}` should be greater than 2")
+_opt_timeout = \
+    _option("model.hp.timeout", 0, int, None, None)
+_opt_max_evals = \
+    _option("model.hp.max_evals", 100000000, int,
+            lambda v: v > 0, "`{}` should be positive")
+_opt_no_progress_loss = \
+    _option("model.hp.no_progress_loss", 50, int,
+            lambda v: v > 0, "`{}` should be positive")
+
+train_option_keys = [
+    _opt_boosting_type.key,
+    _opt_class_weight.key,
+    _opt_learning_rate.key,
+    _opt_max_depth.key,
+    _opt_max_bin.key,
+    _opt_reg_alpha.key,
+    _opt_min_split_gain.key,
+    _opt_n_estimators.key,
+    _opt_importance_type.key,
+    _opt_n_splits.key,
+    _opt_timeout.key,
+    _opt_max_evals.key,
+    _opt_no_progress_loss.key,
+]
+
+
+@elapsed_time  # type: ignore
+def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: int,
+                     n_jobs: int, opts: Dict[str, str]) -> Tuple[Any, float]:
+    def opt(*args):  # type: ignore
+        return get_option_value(opts, *args)
+
+    try:
+        from delphi_tpu.models.gbdt import GradientBoostedTreesModel, gbdt_supported
+        max_depth = int(opt(*_opt_max_depth))
+        n_estimators = int(opt(*_opt_n_estimators))
+        learning_rate = float(opt(*_opt_learning_rate))
+
+        if gbdt_supported(is_discrete, num_class):
+            model = GradientBoostedTreesModel(
+                is_discrete=is_discrete,
+                num_class=num_class,
+                n_estimators=n_estimators,
+                learning_rate=max(learning_rate * 10.0, 0.05),
+                max_depth=min(max(max_depth, 2), 7),
+                max_bin=int(opt(*_opt_max_bin)),
+                min_split_gain=float(opt(*_opt_min_split_gain)),
+                class_weight=str(opt(*_opt_class_weight)),
+            )
+            model.fit(X, y)
+            return model, -model.loss_
+
+        if is_discrete:
+            from delphi_tpu.models.linear import LogisticRegressionModel
+            model = LogisticRegressionModel()
+            model.fit(X, y)
+            return model, -model.loss_
+        from delphi_tpu.models.linear import MLPRegressorModel
+        model = MLPRegressorModel()
+        model.fit(X, y)
+        return model, -model.loss_
+    except Exception as e:
+        _logger.warning(f"Failed to build a stat model because: {e}")
+        return None, 0.0
+
+
+def build_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: int,
+                n_jobs: int, opts: Dict[str, str]) -> Tuple[Tuple[Any, float], float]:
+    """Returns ((model, score), elapsed_seconds); model is None on failure
+    (callers substitute PoorModel, reference train.py:227-229)."""
+    return _build_jax_model(X, y, is_discrete, num_class, n_jobs, opts)
+
+
+def compute_class_nrow_stdv(y: pd.Series, is_discrete: bool) -> Optional[float]:
+    if not is_discrete:
+        return None
+    counts = pd.Series(np.asarray(y)).value_counts(dropna=False)
+    return float(np.std(counts.to_numpy()))
+
+
+def rebalance_training_data(X: pd.DataFrame, y: pd.Series, target: str) \
+        -> Tuple[pd.DataFrame, pd.Series]:
+    """Class rebalancing toward the median class size: oversample minority
+    classes (with replacement; a native stand-in for SMOTEN) and undersample
+    majority classes (reference train.py:242-293; imblearn is not available
+    in this environment)."""
+    rng = np.random.RandomState(42)
+    prev_nrows = len(X)
+    prev_stdv = compute_class_nrow_stdv(y, is_discrete=True)
+
+    y_arr = pd.Series(np.asarray(y)).reset_index(drop=True)
+    is_frame = isinstance(X, pd.DataFrame)
+    if is_frame:
+        X = X.reset_index(drop=True)
+    hist = y_arr.value_counts()
+    median = int(np.median(hist.to_numpy()))
+
+    idx_parts = []
+    for cls, count in hist.items():
+        cls_idx = np.nonzero((y_arr == cls).to_numpy())[0]
+        if count < median:
+            extra = rng.choice(cls_idx, size=median - count, replace=True)
+            idx_parts.append(np.concatenate([cls_idx, extra]))
+        elif count > median:
+            idx_parts.append(rng.choice(cls_idx, size=median, replace=False))
+        else:
+            idx_parts.append(cls_idx)
+
+    idx = np.concatenate(idx_parts) if idx_parts else np.arange(len(X))
+    Xb = X.iloc[idx].reset_index(drop=True) if is_frame else np.asarray(X)[idx]
+    yb = y_arr.iloc[idx].reset_index(drop=True)
+    _logger.info(
+        "Rebalanced training data (y={}, median={}): #rows={}(stdv={}) -> "
+        "#rows={}(stdv={})".format(
+            target, median, prev_nrows, prev_stdv, len(Xb),
+            compute_class_nrow_stdv(yb, is_discrete=True)))
+    return Xb, yb

@@ -1,0 +1,61 @@
+"""ctypes bindings for the native C++ helpers (see `native/`).
+
+The shared library provides batch Levenshtein distance (the hot op of
+cost-weighted PMF computation) and is loaded lazily; callers fall back to
+Python implementations when the library has not been built.
+"""
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+_LIB_NAMES = ("libdelphi_native.so",)
+
+
+def _find_library() -> Optional[str]:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for name in _LIB_NAMES:
+        for sub in ("native/build", "native"):
+            path = os.path.join(here, sub, name)
+            if os.path.exists(path):
+                return path
+    return None
+
+
+class NativeLevenshtein:
+    """Batch edit distances via the C++ kernel."""
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.delphi_levenshtein.restype = ctypes.c_int
+        lib.delphi_levenshtein.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.delphi_levenshtein_batch.restype = None
+        lib.delphi_levenshtein_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double)]
+
+    @classmethod
+    def load(cls) -> Optional["NativeLevenshtein"]:
+        path = _find_library()
+        if path is None:
+            return None
+        return cls(ctypes.CDLL(path))
+
+    def distance(self, x: str, y: str) -> int:
+        return int(self._lib.delphi_levenshtein(x.encode(), y.encode()))
+
+    def batch_distance(self, x: str, ys: Sequence[object]) -> List[Optional[float]]:
+        n = len(ys)
+        arr = (ctypes.c_char_p * n)()
+        valid = []
+        for i, y in enumerate(ys):
+            if y:
+                arr[i] = str(y).encode()
+                valid.append(True)
+            else:
+                arr[i] = None
+                valid.append(False)
+        out = (ctypes.c_double * n)()
+        self._lib.delphi_levenshtein_batch(
+            x.encode(), ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), n, out)
+        return [float(out[i]) if valid[i] else None for i in range(n)]
