@@ -701,18 +701,21 @@ class RepairModel:
                 filled[missing] = [pmf[i] for i in np.nonzero(missing.to_numpy())[0]]
                 pdf[y] = filled
             else:
-                predicted = np.asarray(model.predict(X), dtype=object)
+                predicted = np.asarray(model.predict(X))
+                miss_idx = np.nonzero(missing.to_numpy())[0]
                 if y in integral_columns:
-                    num = pd.to_numeric(pd.Series(predicted), errors="coerce")
-                    predicted = np.round(num.to_numpy()).astype(np.float64)
+                    vals = np.round(pd.to_numeric(
+                        pd.Series(predicted), errors="coerce").to_numpy())
                     filled = pdf[y].astype("float64")
-                    filled[missing] = predicted[missing.to_numpy()]
-                    pdf[y] = filled
+                elif pd.api.types.is_float_dtype(pdf[y].dtype):
+                    vals = pd.to_numeric(
+                        pd.Series(predicted), errors="coerce").to_numpy(dtype=np.float64)
+                    filled = pdf[y].copy()
                 else:
-                    filled = pdf[y].astype(object) \
-                        if not pd.api.types.is_float_dtype(pdf[y]) else pdf[y].copy()
-                    filled[missing] = predicted[missing.to_numpy()]
-                    pdf[y] = filled
+                    vals = predicted.astype(object)
+                    filled = pdf[y].astype(object)
+                filled.iloc[miss_idx] = vals[miss_idx]
+                pdf[y] = filled
         return pdf
 
     def _flatten(self, df: pd.DataFrame) -> pd.DataFrame:

@@ -254,11 +254,22 @@ class GradientBoostedTreesModel:
             X = X.to_numpy()
         return np.asarray(X, dtype=np.float64)
 
+    @staticmethod
+    def _pad(arr: np.ndarray, value: float = 0) -> np.ndarray:
+        """Pads rows to the next power of two so fold/dataset size changes
+        don't trigger XLA recompilation."""
+        n = arr.shape[0]
+        target = max(8, 1 << (n - 1).bit_length())
+        if target == n:
+            return arr
+        pad_shape = (target - n,) + arr.shape[1:]
+        return np.concatenate([arr, np.full(pad_shape, value, arr.dtype)], axis=0)
+
     def fit(self, X: Any, y: Any) -> "GradientBoostedTreesModel":
         Xm = self._as_matrix(X)
         n, d = Xm.shape
         self._binner = _Binner(self.max_bin).fit(Xm)
-        bins = jnp.asarray(self._binner.transform(Xm))
+        bins = jnp.asarray(self._pad(self._binner.transform(Xm)))
         self._n_bins = self._binner.n_bins
         self._n_nodes = 1 << self.max_depth
 
@@ -298,7 +309,8 @@ class GradientBoostedTreesModel:
 
         self._base = base
         trees = _boost(
-            bins, jnp.asarray(yv), jnp.asarray(w, dtype=jnp.float32),
+            bins, jnp.asarray(self._pad(np.asarray(yv, np.float32))),
+            jnp.asarray(self._pad(np.asarray(w, np.float32))),
             self.n_estimators, self.max_depth, self._n_bins, self._n_nodes,
             self._objective, max(self._k, 1),
             self.learning_rate, self.reg_lambda, self.min_split_gain,
@@ -308,12 +320,14 @@ class GradientBoostedTreesModel:
 
     def _raw_scores(self, X: Any) -> np.ndarray:
         Xm = self._as_matrix(X)
-        bins = jnp.asarray(self._binner.transform(Xm))
+        n = Xm.shape[0]
+        bins = jnp.asarray(self._pad(self._binner.transform(Xm)))
         feats, thrs, leaves = (jnp.asarray(t) for t in self._trees)
         F = _predict_boosted(bins, feats, thrs, leaves, self.n_estimators,
                              self.max_depth, self._objective, max(self._k, 1),
                              jnp.asarray(self._base))
-        return np.asarray(F)
+        F = np.asarray(F)
+        return F[..., :n]
 
     def predict_proba(self, X: Any) -> np.ndarray:
         assert self.is_discrete

@@ -76,6 +76,64 @@ train_option_keys = [
 ]
 
 
+def _f1_macro(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    classes = np.unique(y_true)
+    f1s = []
+    for c in classes:
+        tp = float(((y_pred == c) & (y_true == c)).sum())
+        fp = float(((y_pred == c) & (y_true != c)).sum())
+        fn = float(((y_pred != c) & (y_true == c)).sum())
+        p = tp / (tp + fp) if tp + fp > 0 else 0.0
+        r = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f1s.append(2 * p * r / (p + r) if p + r > 0 else 0.0)
+    return float(np.mean(f1s)) if f1s else 0.0
+
+
+def _cv_score(make_model, X: np.ndarray, y: pd.Series, is_discrete: bool,
+              n_splits: int) -> float:
+    """K-fold CV score: f1_macro for classifiers, -MSE for regressors —
+    the same scorers the reference feeds hyperopt (train.py:158)."""
+    y_arr = np.asarray(y)
+    n = len(y_arr)
+    n_splits = max(2, min(n_splits, n))
+    rng = np.random.RandomState(42)
+    order = rng.permutation(n)
+    folds = np.array_split(order, n_splits)
+    scores = []
+    for i, test_idx in enumerate(folds):
+        train_idx = np.concatenate([f for j, f in enumerate(folds) if j != i])
+        if len(train_idx) == 0 or len(test_idx) == 0:
+            continue
+        if is_discrete and len(np.unique(y_arr[train_idx])) < 2:
+            continue
+        try:
+            m = make_model()
+            m.fit(X[train_idx], pd.Series(y_arr[train_idx]))
+            pred = np.asarray(m.predict(X[test_idx]))
+            if is_discrete:
+                scores.append(_f1_macro(y_arr[test_idx].astype(str),
+                                        pred.astype(str)))
+            else:
+                truth = y_arr[test_idx].astype(np.float64)
+                scores.append(-float(((pred.astype(np.float64) - truth) ** 2).mean()))
+        except Exception as e:
+            _logger.warning(f"{e.__class__}: {e}")
+            scores.append(-np.inf)
+    return float(np.mean(scores)) if scores else -np.inf
+
+
+# Candidate hyperparameter grid evaluated by CV — the compact stand-in for the
+# reference's hyperopt TPE search (train.py:148-209); shallow, strongly
+# regularized configs win on small tables, deeper ones on large.
+_GBDT_GRID = [
+    dict(max_depth=3, reg_lambda=3.0, learning_rate=0.05, n_estimators=300),
+    dict(max_depth=3, reg_lambda=1.0, learning_rate=0.1, n_estimators=200),
+    dict(max_depth=5, reg_lambda=1.0, learning_rate=0.1, n_estimators=200),
+    dict(max_depth=5, reg_lambda=1.0, learning_rate=0.1, n_estimators=200,
+         min_child_weight=5.0),
+]
+
+
 @elapsed_time  # type: ignore
 def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: int,
                      n_jobs: int, opts: Dict[str, str]) -> Tuple[Any, float]:
@@ -84,23 +142,31 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
 
     try:
         from delphi_tpu.models.gbdt import GradientBoostedTreesModel, gbdt_supported
-        max_depth = int(opt(*_opt_max_depth))
-        n_estimators = int(opt(*_opt_n_estimators))
-        learning_rate = float(opt(*_opt_learning_rate))
+        n_splits = int(opt(*_opt_n_splits))
+        max_evals = int(opt(*_opt_max_evals))
+        class_weight = str(opt(*_opt_class_weight))
+        X = np.asarray(X)
 
         if gbdt_supported(is_discrete, num_class):
-            model = GradientBoostedTreesModel(
-                is_discrete=is_discrete,
-                num_class=num_class,
-                n_estimators=n_estimators,
-                learning_rate=max(learning_rate * 10.0, 0.05),
-                max_depth=min(max(max_depth, 2), 7),
-                max_bin=int(opt(*_opt_max_bin)),
-                min_split_gain=float(opt(*_opt_min_split_gain)),
-                class_weight=str(opt(*_opt_class_weight)),
-            )
+            def factory(cfg):
+                def make():
+                    return GradientBoostedTreesModel(
+                        is_discrete=is_discrete, num_class=num_class,
+                        max_bin=int(opt(*_opt_max_bin)),
+                        min_split_gain=float(opt(*_opt_min_split_gain)),
+                        class_weight=class_weight, **cfg)
+                return make
+
+            grid = _GBDT_GRID[: max(1, min(len(_GBDT_GRID), max_evals))]
+            best_cfg, best_score = grid[0], -np.inf
+            if len(grid) > 1 and len(X) >= n_splits * 2:
+                for cfg in grid:
+                    score = _cv_score(factory(cfg), X, y, is_discrete, n_splits)
+                    if score > best_score:
+                        best_cfg, best_score = cfg, score
+            model = factory(best_cfg)()
             model.fit(X, y)
-            return model, -model.loss_
+            return model, best_score if np.isfinite(best_score) else -model.loss_
 
         if is_discrete:
             from delphi_tpu.models.linear import LogisticRegressionModel

@@ -4,12 +4,27 @@ testutils.py:65-80)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the axon sitecustomize overwrites JAX_PLATFORMS=axon at
+# interpreter start, so setdefault is not enough — tests must not depend on
+# TPU-tunnel health.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DELPHI_TESTING", "1")
+
+import jax
+
+# sitecustomize may have imported jax already (capturing JAX_PLATFORMS=axon),
+# so update the live config too and drop the axon PJRT factory so backend
+# init can't touch the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
 
 import pathlib
 import sys
