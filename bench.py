@@ -1,0 +1,118 @@
+"""Headline benchmark: end-to-end repair of the raha/flights dataset.
+
+Reproduces the reference's `resources/examples/flights.py` workload: 2376
+rows, ground-truth error cells given, `discreteThreshold=400`, full
+detect->train->repair pipeline, quality scored against flights_clean. The
+reference's captured transcript for this exact workload records
+`Total Processing time is 247.697s` (resources/examples/flights.py.out) with
+precision/recall/F1 = 0.7493.
+
+Prints ONE JSON line: value = wall seconds for the repair run;
+vs_baseline = reference_seconds / ours (speedup, higher is better).
+
+Usage: python bench.py [--scale N]   (replicates rows N times for scale-out
+measurements; quality is only scored at scale 1)
+"""
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE_SECONDS = 247.69667196273804  # flights.py.out, laptop-class CPU
+TESTDATA = "/root/reference/testdata/raha"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scale", type=int, default=1)
+    args = parser.parse_args()
+
+    import numpy as np
+    import pandas as pd
+
+    import jax
+
+    from delphi_tpu import delphi
+    from delphi_tpu.session import get_session
+
+    device = str(jax.devices()[0])
+
+    flights = pd.read_csv(f"{TESTDATA}/flights.csv", dtype=str)
+    clean = pd.read_csv(f"{TESTDATA}/flights_clean.csv", dtype=str)
+
+    # ground-truth error cells: flattened cells != clean values (null-safe)
+    flat = flights.melt(id_vars=["tuple_id"], var_name="attribute",
+                        value_name="value")
+    merged = flat.merge(clean, on=["tuple_id", "attribute"], how="inner")
+    neq = ~((merged["value"] == merged["correct_val"])
+            | (merged["value"].isna() & merged["correct_val"].isna()))
+    error_cells = merged[neq][["tuple_id", "attribute"]].reset_index(drop=True)
+
+    if args.scale > 1:
+        parts = []
+        for i in range(args.scale):
+            part = flights.copy()
+            part["tuple_id"] = part["tuple_id"].astype(str) + f"_{i}"
+            parts.append(part)
+        flights = pd.concat(parts, ignore_index=True)
+        eparts = []
+        for i in range(args.scale):
+            epart = error_cells.copy()
+            epart["tuple_id"] = epart["tuple_id"].astype(str) + f"_{i}"
+            eparts.append(epart)
+        error_cells = pd.concat(eparts, ignore_index=True)
+
+    session = get_session()
+    session.register("flights", flights)
+    session.register("flights_error_cells", error_cells)
+
+    # warm-up: trigger jax backend init so the bench measures the pipeline
+    jax.block_until_ready(jax.numpy.zeros(8).sum())
+
+    t0 = time.time()
+    repaired = delphi.repair \
+        .setTableName("flights") \
+        .setRowId("tuple_id") \
+        .setErrorCells("flights_error_cells") \
+        .setDiscreteThreshold(400) \
+        .run()
+    elapsed = time.time() - t0
+
+    result = {
+        "metric": "flights_e2e_repair_wall_time",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_SECONDS / elapsed, 3),
+        "scale": args.scale,
+        "rows": int(len(flights)),
+        "repairs": int(len(repaired)),
+        "device": device,
+    }
+
+    if args.scale == 1:
+        pdf = repaired.merge(clean, on=["tuple_id", "attribute"], how="inner")
+        rdf = repaired.merge(error_cells, on=["tuple_id", "attribute"],
+                             how="right")
+        rdf = rdf.merge(clean, on=["tuple_id", "attribute"], how="left")
+
+        def nse(a, b):
+            return (a == b) | (a.isna() & b.isna())
+
+        precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean()) \
+            if len(pdf) else 0.0
+        recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean()) \
+            if len(rdf) else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if precision + recall > 0 else 0.0
+        result.update(precision=round(precision, 4), recall=round(recall, 4),
+                      f1=round(f1, 4))
+        print(f"precision={precision:.4f} recall={recall:.4f} f1={f1:.4f} "
+              f"elapsed={elapsed:.1f}s (reference: 247.7s, f1=0.7493)",
+              file=sys.stderr)
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: device meshes, row-sharded kernels, distributed
+training steps.
+
+The reference's only parallelism is Spark data parallelism (SURVEY.md §2.3);
+here the equivalents are explicit SPMD programs over a
+``jax.sharding.Mesh``:
+
+* ``dp`` (rows)   — replaces Spark's executor task parallelism / shuffles;
+  frequency counts, entropies and GBDT histograms reduce with ``psum`` over
+  ICI instead of shuffling.
+* ``tp`` (model)  — shards wide model dimensions (class axis of the
+  per-attribute heads), the analog the reference never had.
+
+Multi-host scale-out uses `jax.distributed.initialize` + the same mesh
+spanning hosts (collectives ride ICI within a slice, DCN across).
+"""
+
+from delphi_tpu.parallel.mesh import make_mesh, shard_rows

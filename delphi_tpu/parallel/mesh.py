@@ -1,0 +1,48 @@
+"""Mesh construction and row sharding helpers."""
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Tuple[str, ...] = ("dp",),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Builds a mesh over the first ``n_devices`` devices.
+
+    With one axis the mesh is pure data-parallel over rows; pass
+    ``axis_names=('dp', 'tp')`` and a ``shape`` to add model parallelism.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        if len(axis_names) == 1:
+            shape = (n,)
+        elif len(axis_names) == 2:
+            tp = 2 if n % 2 == 0 and n >= 2 else 1
+            shape = (n // tp, tp)
+        else:
+            raise ValueError(f"provide `shape` for {len(axis_names)} axes")
+    assert int(np.prod(shape)) == n, f"mesh shape {shape} != {n} devices"
+    return Mesh(np.asarray(devices).reshape(shape), axis_names)
+
+
+def pad_rows_to_multiple(array: np.ndarray, multiple: int,
+                         fill) -> Tuple[np.ndarray, int]:
+    """Pads axis 0 to a multiple of the dp size (shards must be equal)."""
+    n = array.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return array, n
+    pad = np.full((target - n,) + array.shape[1:], fill, dtype=array.dtype)
+    return np.concatenate([array, pad], axis=0), n
+
+
+def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
+    """Places an array on the mesh sharded along axis 0."""
+    spec = P(axis, *([None] * (array.ndim - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))

@@ -1,0 +1,74 @@
+"""Row-sharded SPMD statistics kernels.
+
+These are the distributed counterparts of :mod:`delphi_tpu.ops.freq` /
+:mod:`delphi_tpu.ops.detect`: the code tensor is sharded over the mesh's
+``dp`` axis, each device bincounts its row shard, and ``psum`` over ICI
+replaces the Spark shuffle (reference P1, SURVEY.md §2.3)."""
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from delphi_tpu.parallel.mesh import pad_rows_to_multiple, shard_rows
+
+
+def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarray:
+    """Per-attribute value counts (slot 0 = NULL) over a row-sharded table.
+    codes: int32[n, m] with NULL=-1; padding rows must be -2 (counted into a
+    scratch slot that is dropped)."""
+    dp = mesh.shape["dp"]
+    padded, n = pad_rows_to_multiple(codes, dp, fill=-2)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("dp", None), out_specs=P())
+    def kernel(local):
+        def one(col):
+            return jnp.bincount(col + 2, length=v_pad + 2)
+        counts = jax.vmap(one, in_axes=1)(local)
+        return jax.lax.psum(counts, "dp")
+
+    counts = np.asarray(kernel(shard_rows(padded, mesh)))
+    return counts[:, 1:]  # drop the padding slot
+
+
+def sharded_pair_counts(codes: np.ndarray, pairs: Sequence[Tuple[int, int]],
+                        v_pad: int, mesh: Mesh) -> np.ndarray:
+    """Fused-key pair co-occurrence counts over a row-sharded table;
+    returns int32[n_pairs, (v_pad+1)**2]."""
+    dp = mesh.shape["dp"]
+    padded, n = pad_rows_to_multiple(codes, dp, fill=-2)
+    xi = jnp.asarray([p[0] for p in pairs], dtype=jnp.int32)
+    yi = jnp.asarray([p[1] for p in pairs], dtype=jnp.int32)
+    stride = v_pad + 1
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("dp", None), P(), P()), out_specs=P())
+    def kernel(local, xi, yi):
+        valid = local[:, 0] != -2
+
+        def one(x, y):
+            keys = (local[:, x] + 1) * stride + (local[:, y] + 1)
+            keys = jnp.where(valid, keys, stride * stride)  # scratch slot
+            return jnp.bincount(keys, length=stride * stride + 1)[:-1]
+
+        counts = jax.vmap(one)(xi, yi)
+        return jax.lax.psum(counts, "dp")
+
+    return np.asarray(kernel(shard_rows(padded, mesh), xi, yi))
+
+
+def sharded_null_counts(codes: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """#NULL cells per attribute over a row-sharded table (the distributed
+    NULL detector's reduction)."""
+    dp = mesh.shape["dp"]
+    padded, _ = pad_rows_to_multiple(codes, dp, fill=0)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("dp", None), out_specs=P())
+    def kernel(local):
+        return jax.lax.psum((local == -1).sum(axis=0), "dp")
+
+    return np.asarray(kernel(shard_rows(padded, mesh)))
