@@ -1,0 +1,207 @@
+"""Quality-regression harness mirroring the reference's
+`python/repair/tests/test_model_perf.py` gates.
+
+These are long-running; they only execute when DELPHI_PERF_TESTS is set:
+
+    DELPHI_PERF_TESTS=1 python -m pytest tests/test_model_perf.py -v
+
+Gates (BASELINE.md):
+* iris/boston single- and two-target repair RMSE below LightGBM's + 0.10
+* hospital error detection: precision > 0.65, recall > 0.98 (all attrs);
+  precision > 0.95, recall > 0.98 excluding Score/Sample
+* hospital repair with ground-truth error cells: P/R/F1 > 0.95
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import delphi
+from delphi_tpu.costs import UserDefinedUpdateCostFunction
+from delphi_tpu.errors import (
+    ConstraintErrorDetector, DomainValues, NullErrorDetector, RegExErrorDetector)
+
+from conftest import load_testdata
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("DELPHI_PERF_TESTS"),
+    reason="perf gates only run when DELPHI_PERF_TESTS is set")
+
+CONSTRAINT_PATH = "/root/reference/bin/testdata/hospital_constraints.txt"
+
+HOSPITAL_TARGETS = [
+    "City", "HospitalName", "ZipCode", "Score", "ProviderNumber", "Sample",
+    "Address1", "HospitalType", "HospitalOwner", "PhoneNumber",
+    "EmergencyService", "State", "Stateavg", "CountyName", "MeasureCode",
+    "MeasureName", "Condition",
+]
+
+
+@pytest.fixture(scope="module")
+def perf_session():
+    from delphi_tpu.session import get_session
+    s = get_session()
+    s.register("iris", load_testdata("iris.csv"))
+    s.register("boston", load_testdata("boston.csv", dtype={"CHAS": str, "RAD": str}))
+    s.register("hospital", load_testdata("hospital.csv", dtype=str))
+    return s
+
+
+def _rmse(repaired_df, clean_df):
+    cmp = repaired_df.merge(clean_df, on=["tid", "attribute"], how="inner")
+    return float(np.sqrt(
+        ((cmp["correct_val"].astype(float) - cmp["repaired"].astype(float)) ** 2)
+        .sum() / len(repaired_df)))
+
+
+def _build(name):
+    return delphi.repair.setInput(name).setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()])
+
+
+@pytest.mark.parametrize("target,ulimit", [
+    ("sepal_width", 0.2328), ("sepal_length", 0.3980),
+    ("petal_width", 0.4339), ("petal_length", 0.6787)])
+def test_repair_perf_iris_target_num_1(perf_session, target, ulimit):
+    clean = load_testdata("iris_clean.csv")
+    rmse = _rmse(_build("iris").setTargets([target]).run(), clean)
+    assert rmse < ulimit + 0.10, f"{target}: {rmse}"
+
+
+@pytest.mark.parametrize("targets,ulimit", [
+    (["sepal_width", "sepal_length"], 0.3356),
+    (["sepal_length", "petal_width"], 0.3861),
+    (["petal_width", "petal_length"], 0.5278),
+    (["petal_length", "sepal_width"], 0.4666)])
+def test_repair_perf_iris_target_num_2(perf_session, targets, ulimit):
+    clean = load_testdata("iris_clean.csv")
+    rmse = _rmse(_build("iris").setTargets(targets).run(), clean)
+    assert rmse < ulimit + 0.10, f"{targets}: {rmse}"
+
+
+@pytest.mark.parametrize("target,ulimit", [
+    ("CRIM", 6.1344), ("RAD", 0.9903), ("TAX", 38.5595), ("LSTAT", 3.3115)])
+def test_repair_perf_boston_target_num_1(perf_session, target, ulimit):
+    clean = load_testdata("boston_clean.csv")
+    rmse = _rmse(_build("boston").setTargets([target]).run(), clean)
+    assert rmse < ulimit + 0.10, f"{target}: {rmse}"
+
+
+@pytest.mark.parametrize("targets,ulimit", [
+    (["CRIM", "RAD"], 3.8716), (["RAD", "TAX"], 56.9672),
+    (["TAX", "LSTAT"], 26.6608), (["LSTAT", "CRIM"], 4.6492)])
+def test_repair_perf_boston_target_num_2(perf_session, targets, ulimit):
+    clean = load_testdata("boston_clean.csv")
+    rmse = _rmse(_build("boston").setTargets(targets).run(), clean)
+    assert rmse < ulimit + 0.10, f"{targets}: {rmse}"
+
+
+def _hospital_detectors():
+    return [
+        NullErrorDetector(),
+        ConstraintErrorDetector(CONSTRAINT_PATH),
+        RegExErrorDetector("Sample", "^[0-9]{1,3} patients$"),
+        RegExErrorDetector("Score", "^[0-9]{1,3}%$"),
+        RegExErrorDetector("PhoneNumber", "^[0-9]{10}$"),
+        RegExErrorDetector("ZipCode", "^[0-9]{5}$"),
+        DomainValues(attr="Condition", values=[
+            "children s asthma care", "pneumonia", "heart attack",
+            "surgical infection prevention", "heart failure"]),
+        DomainValues(attr="HospitalType", values=["acute care hospitals"]),
+        DomainValues(attr="EmergencyService", values=["yes", "no"]),
+        DomainValues(attr="State", values=["al", "ak"]),
+    ]
+
+
+def test_error_detection_perf_hospital(perf_session):
+    predicted = _build("hospital") \
+        .setDiscreteThreshold(400) \
+        .setTargets(HOSPITAL_TARGETS) \
+        .setErrorDetectors(_hospital_detectors()) \
+        .option("error.attr_freq_ratio_threshold", "0.0") \
+        .option("error.pairwise_freq_ratio_threshold", "1.0") \
+        .option("error.max_attrs_to_compute_pairwise_stats", "4") \
+        .option("error.max_attrs_to_compute_domains", "2") \
+        .option("error.domain_threshold_alpha", "0.0") \
+        .option("error.domain_threshold_beta", "0.5") \
+        .run(detect_errors_only=True)
+
+    truth = load_testdata("hospital_error_cells.csv").astype({"tid": str})
+    pred = predicted[["tid", "attribute"]].astype({"tid": str})
+    pred_keys = set(map(tuple, pred.to_numpy()))
+    true_keys = set(map(tuple, truth[["tid", "attribute"]].to_numpy()))
+
+    def prf(pred_keys, true_keys):
+        correct = len(pred_keys & true_keys)
+        p = correct / len(pred_keys) if pred_keys else 0.0
+        r = correct / len(true_keys) if true_keys else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+    p, r, f1 = prf(pred_keys, true_keys)
+    print(f"hospital error detection: precision={p:.4f} recall={r:.4f} f1={f1:.4f}")
+    assert p > 0.65 and r > 0.98 and f1 > 0.78, (p, r, f1)
+
+    drop = {"Score", "Sample"}
+    p2, r2, f2 = prf({k for k in pred_keys if k[1] not in drop},
+                     {k for k in true_keys if k[1] not in drop})
+    print(f"hospital error detection (excl Score/Sample): "
+          f"precision={p2:.4f} recall={r2:.4f} f1={f2:.4f}")
+    assert p2 > 0.95 and r2 > 0.98 and f2 > 0.96, (p2, r2, f2)
+
+
+def test_repair_perf_hospital(perf_session):
+    import Levenshtein as lev
+
+    rule_targets = [
+        "EmergencyService", "Condition", "City", "MeasureCode", "HospitalName",
+        "ZipCode", "Address1", "HospitalOwner", "ProviderNumber", "CountyName",
+        "MeasureName"]
+    weighted_prob_targets = ["Score", "Sample"]
+
+    distance = lambda x, y: float(
+        abs(len(str(x)) - len(str(y))) + lev.distance(str(x), str(y)))
+    cf = UserDefinedUpdateCostFunction(f=distance, targets=weighted_prob_targets)
+
+    error_cells = load_testdata("hospital_error_cells.csv").astype(str)
+    from delphi_tpu.session import get_session
+    get_session().register("hospital_error_cells", error_cells)
+
+    repaired = _build("hospital") \
+        .setErrorCells("hospital_error_cells") \
+        .setDiscreteThreshold(400) \
+        .setTargets(HOSPITAL_TARGETS) \
+        .setErrorDetectors([
+            ConstraintErrorDetector(CONSTRAINT_PATH, targets=rule_targets),
+            RegExErrorDetector("Sample", "^[0-9]{1,3} patients$"),
+            RegExErrorDetector("Score", "^[0-9]{1,3}%$")]) \
+        .setRepairByRules(True) \
+        .setUpdateCostFunction(cf) \
+        .option("model.rule.repair_by_regex.disabled", "") \
+        .option("model.rule.repair_by_nearest_values.disabled", "") \
+        .option("model.rule.merge_threshold", "2.0") \
+        .option("model.max_training_column_num", "128") \
+        .option("repair.pmf.cost_weight", "0.1") \
+        .run()
+
+    clean = load_testdata("hospital_clean.csv").astype({"tid": str})
+    clean = clean[clean["attribute"].isin(HOSPITAL_TARGETS)]
+    rep = repaired.astype({"tid": str})
+
+    pdf = rep.merge(clean, on=["tid", "attribute"], how="inner")
+    truth = error_cells[error_cells["attribute"].isin(HOSPITAL_TARGETS)]
+    rdf = truth.merge(rep, on=["tid", "attribute"], how="left") \
+        .merge(clean, on=["tid", "attribute"], how="left")
+
+    def nse(a, b):
+        return (a == b) | (a.isna() & b.isna())
+
+    precision = float((pdf["correct_val"].isna()
+                       | nse(pdf["repaired"], pdf["correct_val"])).mean())
+    recall = float((rdf["correct_val"].isna()
+                    | nse(rdf["repaired"], rdf["correct_val"])).mean())
+    f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+    print(f"hospital repair: precision={precision:.4f} recall={recall:.4f} f1={f1:.4f}")
+    assert precision > 0.95 and recall > 0.95 and f1 > 0.95, (precision, recall, f1)
