@@ -31,13 +31,13 @@ def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
     assert q > 0, f"`q` must be positive, but {q} got"
     n = len(df)
     out = np.zeros((n, FEATURE_DIM), dtype=np.float32)
-    cols = [df[c].map(lambda v: None if pd.isna(v) else str(v)) for c in df.columns]
+    cols = [df[c].tolist() for c in df.columns]
     for i in range(n):
         for col in cols:
-            v = col.iloc[i]
-            if v is None:
+            v = col[i]
+            if v is None or (isinstance(v, float) and np.isnan(v)):
                 continue
-            for g in _qgrams(v, q):
+            for g in _qgrams(str(v), q):
                 out[i, hash(g) % FEATURE_DIM] += 1.0
     return out
 

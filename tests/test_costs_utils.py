@@ -1,0 +1,102 @@
+"""Cost functions, regex-structure repair, option parsing and argtype checks
+(reference test_costs.py / test_utils.py / RegexStructureRepairSuite)."""
+
+import pytest
+
+from delphi_tpu.costs import Levenshtein, UserDefinedUpdateCostFunction
+from delphi_tpu.regex_repair import RegexStructureRepair, RegexTokenType, parse
+from delphi_tpu.utils import get_option_value
+
+
+# -- costs -------------------------------------------------------------------
+
+def test_levenshtein():
+    cf = Levenshtein()
+    assert cf.compute("kitten", "sitting") == 3.0
+    assert cf.compute("abc", "abc") == 0.0
+    assert cf.compute(None, "x") is None
+    assert cf.compute("x", None) is None
+
+
+def test_levenshtein_compute_many():
+    cf = Levenshtein()
+    assert cf.compute_many("abc", ["abd", "abc", None]) == [1.0, 0.0, None]
+    assert cf.compute_many(None, ["x"]) is None
+
+
+def test_user_defined_cost_function():
+    cf = UserDefinedUpdateCostFunction(f=lambda x, y: float(len(x) + len(y)))
+    assert cf.compute("ab", "c") == 3.0
+    with pytest.raises(ValueError, match="float cost value"):
+        UserDefinedUpdateCostFunction(f=lambda x, y: "not a float")
+    with pytest.raises(ValueError, match="float cost value"):
+        UserDefinedUpdateCostFunction(f=lambda x: 1.0)  # wrong arity
+
+
+def test_cost_function_targets():
+    cf = Levenshtein(targets=["Score"])
+    assert cf.targets == ["Score"]
+
+
+# -- regex structure repair --------------------------------------------------
+
+def test_regex_parse_tokens():
+    tokens = parse("^[0-9]{1,3} patients$")
+    assert tokens == [
+        (RegexTokenType.OTHER, "^"),
+        (RegexTokenType.PATTERN, "[0-9]{1,3}"),
+        (RegexTokenType.CONSTANT, " patients"),
+        (RegexTokenType.OTHER, "$"),
+    ]
+    tokens = parse("^[0-9]{1,3}%$")
+    assert [t for t, _ in tokens] == [
+        RegexTokenType.OTHER, RegexTokenType.PATTERN, RegexTokenType.CONSTANT,
+        RegexTokenType.OTHER]
+
+
+@pytest.mark.parametrize("pattern,cases", [
+    ("^[0-9]{1,3} patients$", [
+        ("32 patixxts", "32 patients"),
+        ("619 paxienxs", "619 patients"),
+        ("x2 patixxts", None)]),
+    ("^[0-9]{1,3}%", [
+        ("33x", "33%"),
+        ("x2%", None)]),
+    ("^[0-9]{2}-[0-9]{2}-[0-9]{2}-[0-9]{2}$", [
+        ("23.39.23.11", "23-39-23-11"),
+        ("23.x9.2x.1x", None)]),
+])
+def test_regex_structure_repair(pattern, cases):
+    repairer = RegexStructureRepair(pattern)
+    for dirty, expected in cases:
+        assert repairer(dirty) == expected, (pattern, dirty)
+
+
+def test_regex_structure_repair_none_input():
+    assert RegexStructureRepair("^[0-9]{2}$")(None) is None
+
+
+# -- option parsing ----------------------------------------------------------
+
+def test_get_option_value_default():
+    assert get_option_value({}, "k", 5, int) == 5
+
+
+def test_get_option_value_cast():
+    assert get_option_value({"k": "7"}, "k", 5, int) == 7
+    assert get_option_value({"k": "0.5"}, "k", 1.0, float) == 0.5
+
+
+def test_get_option_value_invalid_raises_under_testing():
+    with pytest.raises(ValueError, match="Failed to cast"):
+        get_option_value({"k": "xx"}, "k", 5, int)
+    with pytest.raises(ValueError, match="should be positive"):
+        get_option_value({"k": "-1"}, "k", 5, int,
+                         lambda v: v > 0, "`{}` should be positive")
+
+
+def test_get_option_value_bool_truthiness():
+    # the reference relies on python truthiness of the raw string: any
+    # non-empty string (even "false") enables, "" disables
+    assert get_option_value({"k": ""}, "k", True, bool) is False
+    assert get_option_value({"k": "false"}, "k", True, bool) is True

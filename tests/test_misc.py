@@ -1,0 +1,157 @@
+"""RepairMisc behaviors (reference test_misc.py / RepairMiscSuite coverage)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import delphi
+
+
+@pytest.fixture
+def adult(session, adult_df):
+    session.register("adult", adult_df)
+    return adult_df
+
+
+def test_required_options(session):
+    with pytest.raises(ValueError, match="Required options not found"):
+        delphi.misc.flatten()
+    with pytest.raises(ValueError, match="Required options not found"):
+        delphi.misc.repair()
+
+
+def test_flatten(adult):
+    df = delphi.misc.options({"table_name": "adult", "row_id": "tid"}).flatten()
+    assert list(df.columns) == ["tid", "attribute", "value"]
+    assert len(df) == 20 * 7
+    row0 = df[(df.tid == 0) & (df.attribute == "Age")]["value"].iloc[0]
+    assert row0 == "31-50"
+    # NULL cells flatten to None
+    assert df["value"].isna().sum() == 7
+
+
+def test_repair_applies_updates(adult, session):
+    updates = pd.DataFrame({
+        "tid": [3, 12, 16],
+        "attribute": ["Sex", "Age", "Income"],
+        "repaired": ["Female", "18-21", "MoreThan50K"],
+    })
+    session.register("predicted", updates)
+    df = delphi.misc.options({
+        "repair_updates": "predicted", "table_name": "adult", "row_id": "tid",
+    }).repair()
+    assert df[df.tid == 3]["Sex"].iloc[0] == "Female"
+    assert df[df.tid == 12]["Age"].iloc[0] == "18-21"
+    assert df[df.tid == 16]["Income"].iloc[0] == "MoreThan50K"
+    # untouched cells stay
+    assert df[df.tid == 0]["Sex"].iloc[0] == "Male"
+
+
+def test_repair_integral_rounding(session):
+    base = pd.DataFrame({"tid": [0, 1], "v": [10, 20], "w": ["a", "b"]})
+    session.register("int_base", base)
+    session.register("int_updates", pd.DataFrame({
+        "tid": [0], "attribute": ["v"], "repaired": ["14.7"]}))
+    df = delphi.misc.options({
+        "repair_updates": "int_updates", "table_name": "int_base",
+        "row_id": "tid"}).repair()
+    assert df[df.tid == 0]["v"].iloc[0] == 15  # rounded + cast
+
+
+def test_describe(adult):
+    df = delphi.misc.option("table_name", "adult").describe()
+    assert set(df.columns) == {
+        "attrName", "distinctCnt", "min", "max", "nullCnt", "avgLen", "maxLen", "hist"}
+    stats = df.set_index("attrName")
+    assert stats.loc["Sex", "distinctCnt"] == 2
+    assert stats.loc["Sex", "nullCnt"] == 3
+    assert stats.loc["tid", "distinctCnt"] == 20
+
+
+def test_split_input_table(adult):
+    df = delphi.misc.options({
+        "table_name": "adult", "row_id": "tid", "k": "2"}).splitInputTable()
+    assert list(df.columns) == ["tid", "k"]
+    assert len(df) == 20
+    assert set(df["k"].unique()) <= {0, 1}
+
+
+def test_split_input_table_validates_k(adult):
+    with pytest.raises(ValueError, match="must be an integer"):
+        delphi.misc.options({
+            "table_name": "adult", "row_id": "tid", "k": "x"}).splitInputTable()
+
+
+def test_inject_null(session):
+    session.register("t10", pd.DataFrame({"id": range(10), "v": ["x"] * 10,
+                                          "w": ["y"] * 10}))
+    df = delphi.misc.options({
+        "table_name": "t10", "target_attr_list": "v", "null_ratio": "1.0",
+    }).injectNull()
+    assert df["v"].isna().all()
+    assert df["w"].notna().all()
+
+
+def test_inject_null_validates_ratio(session):
+    session.register("t1", pd.DataFrame({"id": [1], "v": ["x"], "w": ["y"]}))
+    with pytest.raises(ValueError, match="null_ratio"):
+        delphi.misc.options({
+            "table_name": "t1", "target_attr_list": "v", "null_ratio": "nope",
+        }).injectNull()
+
+
+def test_to_histogram(adult):
+    df = delphi.misc.options({
+        "table_name": "adult", "row_id": "tid",
+        "targets": "Income,Sex"}).toHistogram()
+    assert list(df.columns) == ["attribute", "histogram"]
+    hist = {r["attribute"]: {e["value"]: e["cnt"] for e in r["histogram"]}
+            for _, r in df.iterrows()}
+    assert hist["Sex"] == {"Male": 10, "Female": 7}
+    assert hist["Income"] == {"LessThan50K": 14, "MoreThan50K": 4}
+
+
+def test_to_error_map(adult, session):
+    session.register("err_cells", pd.DataFrame({
+        "tid": [3, 5], "attribute": ["Sex", "Age"]}))
+    df = delphi.misc.options({
+        "table_name": "adult", "row_id": "tid", "error_cells": "err_cells",
+    }).toErrorMap()
+    assert list(df.columns) == ["tid", "error_map"]
+    m = df.set_index("tid")["error_map"]
+    assert len(m.loc[0]) == 7
+    assert m.loc[3] == "----*--"   # Sex is the 5th attribute
+    assert m.loc[5] == "*------"   # Age is the 1st
+    assert m.loc[0] == "-------"
+
+
+def test_generate_dep_graph(adult, tmp_path):
+    path = str(tmp_path / "graph")
+    delphi.misc.options({
+        "table_name": "adult", "path": path,
+        "pairwise_attr_stat_threshold": "2.0",
+    }).generateDepGraph()
+    dot = open(os.path.join(path, "depgraph.dot")).read()
+    assert dot.startswith("digraph {")
+    assert "Relationship" in dot or "Sex" in dot
+
+
+def test_generate_dep_graph_no_correlated_pair(adult, tmp_path):
+    from delphi_tpu.session import AnalysisException
+    with pytest.raises(AnalysisException, match="No highly-correlated"):
+        delphi.misc.options({
+            "table_name": "adult", "path": str(tmp_path / "g0"),
+            "pairwise_attr_stat_threshold": "0.00001",
+        }).generateDepGraph()
+
+
+def test_generate_dep_graph_no_overwrite(adult, tmp_path):
+    path = str(tmp_path / "graph2")
+    opts = {"table_name": "adult", "path": path,
+            "pairwise_attr_stat_threshold": "2.0"}
+    delphi.misc.options(opts).generateDepGraph()
+    from delphi_tpu.session import AnalysisException
+    with pytest.raises(AnalysisException, match="already exists"):
+        delphi.misc.options(opts).generateDepGraph()
