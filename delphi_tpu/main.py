@@ -1,0 +1,67 @@
+"""Batch entry point (reference `python/main.py:32-92`):
+
+    python -m delphi_tpu.main --input testdata/adult.csv --row-id tid \\
+        --output /tmp/adult_repaired.csv [--repair-data]
+
+Reads a CSV (or a name already registered in the session catalog), runs the
+repair pipeline, and writes the result CSV. `--detect-only` emits the error
+cells instead of repairs; `--constraints` wires a ConstraintErrorDetector.
+"""
+
+import argparse
+import sys
+
+import pandas as pd
+
+from delphi_tpu import delphi
+from delphi_tpu.errors import ConstraintErrorDetector, NullErrorDetector
+from delphi_tpu.session import get_session
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="delphi_tpu batch repair")
+    parser.add_argument("--db", dest="db", type=str, default="",
+                        help="database name of the input table")
+    parser.add_argument("--input", dest="input", type=str, required=True,
+                        help="CSV path or registered table name")
+    parser.add_argument("--row-id", dest="row_id", type=str, required=True)
+    parser.add_argument("--output", dest="output", type=str, required=True,
+                        help="output CSV path")
+    parser.add_argument("--targets", dest="targets", type=str, default="",
+                        help="comma-separated target attributes")
+    parser.add_argument("--constraints", dest="constraints", type=str, default="",
+                        help="denial-constraint file path")
+    parser.add_argument("--discrete-threshold", dest="discrete_threshold",
+                        type=int, default=80)
+    parser.add_argument("--detect-only", dest="detect_only", action="store_true")
+    parser.add_argument("--repair-data", dest="repair_data", action="store_true",
+                        help="write the fully repaired table instead of updates")
+    args = parser.parse_args(argv)
+
+    session = get_session()
+    if args.input.endswith(".csv"):
+        name = session.register("batch_input", pd.read_csv(args.input))
+    else:
+        name = session.qualified_name(args.db, args.input)
+
+    detectors = [NullErrorDetector()]
+    if args.constraints:
+        detectors.append(ConstraintErrorDetector(constraint_path=args.constraints))
+
+    model = delphi.repair \
+        .setTableName(name) \
+        .setRowId(args.row_id) \
+        .setErrorDetectors(detectors) \
+        .setDiscreteThreshold(args.discrete_threshold)
+    if args.targets:
+        model = model.setTargets(args.targets.split(","))
+
+    result = model.run(detect_errors_only=args.detect_only,
+                       repair_data=args.repair_data)
+    result.to_csv(args.output, index=False)
+    print(f"wrote {len(result)} rows to {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,10 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-MAX_MULTICLASS = 64
+MAX_MULTICLASS = 24
 
 
 def gbdt_supported(is_discrete: bool, num_class: int) -> bool:
+    """K class-trees per round get expensive fast; very wide multiclass
+    targets route to the logistic head instead (train.py)."""
     return (not is_discrete) or num_class <= MAX_MULTICLASS
 
 
@@ -292,6 +294,8 @@ class GradientBoostedTreesModel:
             else:
                 self._objective = "multiclass"
                 self._k = k
+                # bound the k-trees-per-round cost
+                self.n_estimators = min(self.n_estimators, max(40, 400 // k))
                 yv = codes.astype(np.float32)
                 priors = np.zeros(k)
                 np.add.at(priors, codes, w)

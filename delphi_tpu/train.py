@@ -158,6 +158,9 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 return make
 
             grid = _GBDT_GRID[: max(1, min(len(_GBDT_GRID), max_evals))]
+            if is_discrete and num_class > 8:
+                # wide multiclass: CV grid search is too costly for the gain
+                grid = grid[:1]
             best_cfg, best_score = grid[0], -np.inf
             if len(grid) > 1 and len(X) >= n_splits * 2:
                 for cfg in grid:

@@ -1,0 +1,25 @@
+"""Repair the adult fixture's NULL cells (reference resources/examples/adult.py).
+
+    python examples/adult.py [path-to-testdata]
+"""
+
+import sys
+
+import pandas as pd
+
+from delphi_tpu import delphi, ConstraintErrorDetector, NullErrorDetector
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata"
+
+delphi.register_table("adult", pd.read_csv(f"{TESTDATA}/adult.csv"))
+
+repaired_df = delphi.repair \
+    .setInput("adult") \
+    .setRowId("tid") \
+    .setErrorDetectors([
+        NullErrorDetector(),
+        ConstraintErrorDetector(constraint_path=f"{TESTDATA}/adult_constraints.txt"),
+    ]) \
+    .run()
+
+print(repaired_df.to_string(index=False))

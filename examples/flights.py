@@ -1,0 +1,45 @@
+"""Raha flights repair with ground-truth error cells
+(reference resources/examples/flights.py) — the headline benchmark workload,
+also runnable via `python bench.py`.
+
+    python examples/flights.py [path-to-raha-testdata]
+"""
+
+import sys
+
+import pandas as pd
+
+from delphi_tpu import delphi
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata/raha"
+
+flights = pd.read_csv(f"{TESTDATA}/flights.csv", dtype=str)
+clean = pd.read_csv(f"{TESTDATA}/flights_clean.csv", dtype=str)
+delphi.register_table("flights", flights)
+
+# ground truth: flattened cells that differ from the clean values
+flat = delphi.misc.options({"table_name": "flights", "row_id": "tuple_id"}).flatten()
+merged = flat.merge(clean, on=["tuple_id", "attribute"], how="inner")
+neq = ~((merged["value"] == merged["correct_val"])
+        | (merged["value"].isna() & merged["correct_val"].isna()))
+delphi.register_table(
+    "error_cells_ground_truth",
+    merged[neq][["tuple_id", "attribute"]].reset_index(drop=True))
+
+repaired_df = delphi.repair \
+    .setTableName("flights") \
+    .setRowId("tuple_id") \
+    .setErrorCells("error_cells_ground_truth") \
+    .setDiscreteThreshold(400) \
+    .run()
+
+pdf = repaired_df.merge(clean, on=["tuple_id", "attribute"], how="inner")
+rdf = delphi.table("error_cells_ground_truth") \
+    .merge(repaired_df, on=["tuple_id", "attribute"], how="left") \
+    .merge(clean, on=["tuple_id", "attribute"], how="left")
+
+nse = lambda a, b: (a == b) | (a.isna() & b.isna())
+precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean())
+recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean())
+f1 = 2 * precision * recall / (precision + recall)
+print(f"Precision={precision} Recall={recall} F1={f1}")

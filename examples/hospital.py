@@ -1,0 +1,42 @@
+"""End-to-end hospital repair with detectors + rules
+(reference resources/examples/hospital.py): detect errors with NULL + denial
+constraints, repair with FD rules + stat models, score against the ground
+truth.
+
+    python examples/hospital.py [path-to-testdata]
+"""
+
+import sys
+
+import pandas as pd
+
+from delphi_tpu import delphi, ConstraintErrorDetector, NullErrorDetector
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/bin/testdata"
+
+hospital = pd.read_csv(f"{TESTDATA}/hospital.csv", dtype=str)
+clean = pd.read_csv(f"{TESTDATA}/hospital_clean.csv", dtype=str)
+delphi.register_table("hospital", hospital)
+
+repaired_df = delphi.repair \
+    .setInput("hospital") \
+    .setRowId("tid") \
+    .setErrorDetectors([
+        NullErrorDetector(),
+        ConstraintErrorDetector(constraint_path=f"{TESTDATA}/hospital_constraints.txt"),
+    ]) \
+    .setDiscreteThreshold(100) \
+    .setRepairByRules(True) \
+    .run()
+
+# Precision: correct repairs / repairs performed; recall: correct / all errors
+pdf = repaired_df.merge(clean, on=["tid", "attribute"], how="inner")
+truth = pd.read_csv(f"{TESTDATA}/hospital_error_cells.csv", dtype=str)
+rdf = truth.merge(repaired_df, on=["tid", "attribute"], how="left") \
+    .merge(clean, on=["tid", "attribute"], how="left")
+
+nse = lambda a, b: (a == b) | (a.isna() & b.isna())
+precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean())
+recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean())
+f1 = 2 * precision * recall / (precision + recall)
+print(f"Precision={precision} Recall={recall} F1={f1}")

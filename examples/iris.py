@@ -1,0 +1,30 @@
+"""Continuous-attribute regression repair on iris
+(reference resources/examples/iris.py): NULL cells are filled by the JAX GBDT
+regressors and scored as RMSE/MAE against the clean data.
+
+    python examples/iris.py [path-to-testdata]
+"""
+
+import sys
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu import delphi, NullErrorDetector
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/bin/testdata"
+
+delphi.register_table("iris", pd.read_csv(f"{TESTDATA}/iris.csv"))
+clean = pd.read_csv(f"{TESTDATA}/iris_clean.csv")
+
+repaired_df = delphi.repair \
+    .setInput("iris") \
+    .setRowId("tid") \
+    .setErrorDetectors([NullErrorDetector()]) \
+    .run()
+
+cmp = repaired_df.merge(clean, on=["tid", "attribute"], how="inner")
+err = cmp["correct_val"].astype(float) - cmp["repaired"].astype(float)
+rmse = float(np.sqrt((err ** 2).mean()))
+mae = float(err.abs().mean())
+print(f"RMSE={rmse} MAE={mae}")

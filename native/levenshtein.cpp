@@ -1,0 +1,72 @@
+// Native helpers for delphi_tpu: batch Levenshtein distance.
+//
+// The reference computes per-cell edit distances inside pandas UDFs via the
+// python-Levenshtein extension (costs.py:38-49, model.py:565-581); here the
+// host-side hot loop (cost weighting of PMFs: one dirty value against every
+// candidate class) is a single C call over the candidate batch, avoiding
+// per-pair Python dispatch.
+//
+// Build: make -C native   (produces native/build/libdelphi_native.so, loaded
+// via ctypes by delphi_tpu/utils/native.py)
+
+#include <algorithm>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+int levenshtein(const char* a, const char* b) {
+  const size_t la = std::strlen(a);
+  const size_t lb = std::strlen(b);
+  if (la == 0) return static_cast<int>(lb);
+  if (lb == 0) return static_cast<int>(la);
+
+  const char* shorter = a;
+  const char* longer = b;
+  size_t ls = la, ll = lb;
+  if (ls > ll) {
+    std::swap(shorter, longer);
+    std::swap(ls, ll);
+  }
+
+  std::vector<int> prev(ls + 1);
+  std::vector<int> cur(ls + 1);
+  for (size_t j = 0; j <= ls; ++j) prev[j] = static_cast<int>(j);
+
+  for (size_t i = 1; i <= ll; ++i) {
+    cur[0] = static_cast<int>(i);
+    const char ci = longer[i - 1];
+    for (size_t j = 1; j <= ls; ++j) {
+      const int del = prev[j] + 1;
+      const int ins = cur[j - 1] + 1;
+      const int sub = prev[j - 1] + (ci != shorter[j - 1] ? 1 : 0);
+      cur[j] = std::min(del, std::min(ins, sub));
+    }
+    std::swap(prev, cur);
+  }
+  return prev[ls];
+}
+
+}  // namespace
+
+extern "C" {
+
+int delphi_levenshtein(const char* a, const char* b) {
+  if (a == nullptr || b == nullptr) return -1;
+  return levenshtein(a, b);
+}
+
+// Distances from `x` to each of `ys` (null entries yield -1.0).
+void delphi_levenshtein_batch(const char* x, const char** ys, int n,
+                              double* out) {
+  if (x == nullptr) {
+    for (int i = 0; i < n; ++i) out[i] = -1.0;
+    return;
+  }
+  for (int i = 0; i < n; ++i) {
+    out[i] = ys[i] == nullptr ? -1.0 : static_cast<double>(levenshtein(x, ys[i]));
+  }
+}
+
+}  // extern "C"
