@@ -21,15 +21,41 @@ result list is sorted ascending — strongest correlate first.
 import math
 from typing import Dict, List, Sequence, Tuple
 
+import jax
 import numpy as np
 
-from delphi_tpu.ops.freq import FreqStats, Pair
+from delphi_tpu.ops.freq import FreqStats, Pair, _pallas_policy
+
+# Below this many count groups the f64 host reduction wins; above it the
+# single-pass VPU kernel (ops/pallas_kernels.py) avoids pulling big pair
+# matrices through host memory.
+_PALLAS_ENTROPY_MIN_GROUPS = 1 << 16
+
+
+def _use_pallas_entropy(n_groups: int) -> bool:
+    policy = _pallas_policy()
+    if policy in ("0", "off", "never"):
+        return False
+    if policy in ("1", "on", "force"):
+        return True
+    return jax.default_backend() == "tpu" and \
+        n_groups >= _PALLAS_ENTROPY_MIN_GROUPS
 
 
 def _entropy_with_correction(counts: np.ndarray, n_rows: int, ub_domain: int) \
         -> float:
     """-sum (c/n) log2 (c/n) over observed groups, plus the missing-mass
     correction for unobserved/filtered groups."""
+    if _use_pallas_entropy(counts.size):
+        from delphi_tpu.ops.pallas_kernels import pallas_entropy_terms
+
+        h, total, n_observed = pallas_entropy_terms(counts, n_rows)
+        if n_rows > total:
+            ub = max(ub_domain - n_observed, 1)
+            avg = max((n_rows - total) / ub, 1.0)
+            h += -ub * (avg / n_rows) * math.log2(avg / n_rows)
+        return h
+
     observed = counts[counts > 0].astype(np.float64)
     total = float(observed.sum())
     p = observed / n_rows

@@ -16,6 +16,7 @@ Unlike the reference, there is no 64-attribute limit: pairs are batched, not
 packed into a single grouping-set bitmap.
 """
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -27,6 +28,25 @@ import numpy as np
 from delphi_tpu.table import EncodedTable
 
 Pair = Tuple[str, str]
+
+
+def _pallas_policy() -> str:
+    """DELPHI_PALLAS=1 forces the pallas kernels (interpret mode off-TPU),
+    0 disables them, auto (default) uses them only on a real TPU backend."""
+    return os.environ.get("DELPHI_PALLAS", "auto").lower()
+
+
+def use_pallas_pair_counts(vx: int, vy: int, n_rows: int = 0) -> bool:
+    from delphi_tpu.ops import pallas_kernels as pk
+
+    policy = _pallas_policy()
+    if policy in ("0", "off", "never"):
+        return False
+    if not pk.pallas_supported(vx, vy, n_rows):
+        return False
+    if policy in ("1", "on", "force"):
+        return True
+    return jax.default_backend() == "tpu"
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -127,7 +147,17 @@ def compute_freq_stats(table: EncodedTable,
     singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
 
     pair_mats: Dict[Pair, np.ndarray] = {}
-    if pairs:
+    if pairs and use_pallas_pair_counts(v_pad, v_pad, table.n_rows):
+        # MXU one-hot-matmul kernel (ops/pallas_kernels.py): per-pair calls,
+        # each contracting row tiles into a [Vx, Vy] VMEM accumulator.
+        # Columns are sliced on device — no host round-trip.
+        from delphi_tpu.ops.pallas_kernels import pallas_pair_counts
+
+        for x, y in pairs:
+            pair_mats[(x, y)] = pallas_pair_counts(
+                codes[:, name_to_idx[x]], codes[:, name_to_idx[y]],
+                vocab_sizes[x], vocab_sizes[y])
+    elif pairs:
         xi = jnp.asarray([name_to_idx[x] for x, _ in pairs], dtype=jnp.int32)
         yi = jnp.asarray([name_to_idx[y] for _, y in pairs], dtype=jnp.int32)
         flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))

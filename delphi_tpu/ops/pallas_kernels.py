@@ -1,0 +1,187 @@
+"""Pallas TPU kernels for the hot statistics ops.
+
+The single hottest kernel in the pipeline is pair co-occurrence counting
+(the reference's `GROUP BY GROUPING SETS` aggregation, RepairApi.scala:231-273,
+which every entropy/domain computation feeds on). The XLA fallback in
+``ops/freq.py`` lowers `jnp.bincount` to scatter-adds; on TPU scatters
+serialize on the VPU. The Pallas kernel here instead maps the count onto the
+MXU systolic array:
+
+    counts[Vx, Vy] = sum_r one_hot(x_r)^T @ one_hot(y_r)
+
+tiled over rows, with the one-hot blocks materialized **only in VMEM** (never
+in HBM) and contracted immediately — a classic "fuse the encode into the
+matmul" pattern. HBM traffic is just the two int32 code vectors plus one
+[V, V] accumulator, instead of two [n, V] one-hot matrices.
+
+Padding: rows are padded to a multiple of the tile with the sentinel -2 so the
+shifted code (-1) matches no one-hot column (NULL itself is slot 0 via the +1
+shift, matching SQL GROUP BY semantics).
+
+A second kernel computes the xlogx entropy partial sums used by
+``ops/entropy.py`` (H terms of RepairApi.scala:284-394) in one VMEM pass.
+
+Kernels run compiled on TPU and in interpret mode on CPU (tests exercise both
+paths against the XLA reference implementation).
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_ROW_TILE = 4096         # rows contracted per grid step
+_LANE = 128              # TPU lane width; vocab padded to a multiple
+_PAD_SENTINEL = -2       # shifted to -1: matches no one-hot column
+_VMEM_V_LIMIT = 2048     # fall back to XLA above this padded vocab size
+
+
+def _interpret_mode() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Pair co-occurrence counts: one-hot matmul on the MXU
+# ---------------------------------------------------------------------------
+
+def _pair_count_kernel(x_ref, y_ref, out_ref):
+    """Grid step i contracts one row tile into the [Vx, Vy] accumulator."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # codes arrive pre-shifted (+1, NULL=0, pad=-1) as [ROW_TILE, 1] blocks
+    x = x_ref[:, 0]
+    y = y_ref[:, 0]
+    vx = out_ref.shape[0]
+    vy = out_ref.shape[1]
+
+    # One-hot blocks live only in VMEM registers; built by iota compare.
+    col_x = jax.lax.broadcasted_iota(jnp.int32, (_ROW_TILE, vx), 1)
+    col_y = jax.lax.broadcasted_iota(jnp.int32, (_ROW_TILE, vy), 1)
+    oh_x = (x[:, None] == col_x).astype(jnp.float32)
+    oh_y = (y[:, None] == col_y).astype(jnp.float32)
+
+    # [Vx, Vy] += X^T Y on the MXU (contract the row-tile axis).
+    out_ref[:] += jax.lax.dot_general(
+        oh_x, oh_y,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _pair_counts_padded(x_codes: jnp.ndarray, y_codes: jnp.ndarray,
+                        vx_pad: int, vy_pad: int, interpret: bool) -> jnp.ndarray:
+    """Takes raw codes (NULL=-1) on device; shift and row padding are fused
+    into the same XLA program so no extra host round-trip happens."""
+    n = x_codes.shape[0]
+    n_pad = _round_up(max(n, 1), _ROW_TILE)
+    pad_cfg = (0, n_pad - n)
+    x_shift = jnp.pad(x_codes.astype(jnp.int32) + 1, pad_cfg,
+                      constant_values=_PAD_SENTINEL + 1)
+    y_shift = jnp.pad(y_codes.astype(jnp.int32) + 1, pad_cfg,
+                      constant_values=_PAD_SENTINEL + 1)
+    n_tiles = n_pad // _ROW_TILE
+    x2 = x_shift.reshape(-1, 1)
+    y2 = y_shift.reshape(-1, 1)
+    counts = pl.pallas_call(
+        _pair_count_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, 1), lambda i: (i, 0),
+                         memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec((_ROW_TILE, 1), lambda i: (i, 0),
+                         memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((vx_pad, vy_pad), lambda i: (0, 0),
+                               memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((vx_pad, vy_pad), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_tiles * _ROW_TILE * vx_pad * vy_pad,
+            bytes_accessed=8 * n_tiles * _ROW_TILE + 4 * vx_pad * vy_pad,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x2, y2)
+    return counts.astype(jnp.int32)
+
+
+def pallas_pair_counts(x_codes: np.ndarray, y_codes: np.ndarray,
+                       vx: int, vy: int) -> np.ndarray:
+    """Co-occurrence count matrix [vx+1, vy+1] (slot 0 = NULL) for two int32
+    code columns with NULL=-1. MXU one-hot-matmul kernel; exact counts
+    (f32 accumulation is exact below 2^24 rows per shard)."""
+    vx_pad = _round_up(vx + 1, _LANE)
+    vy_pad = _round_up(vy + 1, _LANE)
+    counts = _pair_counts_padded(jnp.asarray(x_codes), jnp.asarray(y_codes),
+                                 vx_pad, vy_pad, _interpret_mode())
+    return np.asarray(counts)[: vx + 1, : vy + 1]
+
+
+def pallas_supported(vx: int, vy: int, n_rows: int = 0) -> bool:
+    """Guards for the MXU kernel: the [Vx, Vy] f32 accumulator plus two
+    one-hot row tiles must fit comfortably in ~16 MB of VMEM, and counts must
+    stay exactly representable in f32 — any cell can reach n_rows, so shards
+    with >= 2^24 rows fall back to the exact XLA int32 path."""
+    if n_rows >= (1 << 24):
+        return False
+    vx_pad = _round_up(vx + 1, _LANE)
+    vy_pad = _round_up(vy + 1, _LANE)
+    if vx_pad > _VMEM_V_LIMIT or vy_pad > _VMEM_V_LIMIT:
+        return False
+    acc = vx_pad * vy_pad * 4
+    tiles = _ROW_TILE * (vx_pad + vy_pad) * 4
+    return acc + tiles < 12 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Entropy partial sums: single-pass VPU reduction
+# ---------------------------------------------------------------------------
+
+def pallas_entropy_terms(counts: np.ndarray, n_rows: int) \
+        -> Tuple[float, float, int]:
+    """(h_observed, total_observed, n_observed_groups) for one count vector —
+    the observed part of the corrected entropy (RepairApi.scala:306-325);
+    the missing-mass correction stays in ops/entropy.py."""
+    flat = counts.ravel().astype(np.float32)
+    n_pad = _round_up(max(flat.size, 1), _LANE)
+    buf = np.zeros((1, n_pad), dtype=np.float32)
+    buf[0, : flat.size] = flat
+
+    interpret = _interpret_mode()
+    out = pl.pallas_call(
+        _entropy_kernel_factory(float(n_rows)),
+        in_specs=[pl.BlockSpec((1, n_pad),
+                               memory_space=pltpu.ANY if interpret else pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 8),
+                               memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 8), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(buf))
+    out = np.asarray(out)
+    return float(out[0, 0]), float(out[0, 1]), int(out[0, 2])
+
+
+def _entropy_kernel_factory(n_rows: float):
+    def kernel(c_ref, out_ref):
+        c = c_ref[:]
+        nz = c > 0.0
+        p = jnp.where(nz, c, 1.0) / n_rows
+        h = -jnp.sum(jnp.where(nz, p * jnp.log2(p), 0.0)).reshape(1, 1)
+        tot = jnp.sum(c).reshape(1, 1)
+        cnt = jnp.sum(nz.astype(jnp.float32)).reshape(1, 1)
+        out_ref[:] = jnp.concatenate(
+            [h, tot, cnt, jnp.zeros((1, 5), jnp.float32)], axis=1)
+
+    return kernel

@@ -22,16 +22,26 @@ def _find_library() -> Optional[str]:
     return None
 
 
+def _u32(s: str) -> "ctypes.Array":
+    """str -> uint32 codepoint array (Python `str` semantics, not UTF-8
+    bytes — 'café' has length 4)."""
+    buf = s.encode("utf-32-le")
+    n = len(buf) // 4
+    return (ctypes.c_uint32 * max(n, 1)).from_buffer_copy(buf or b"\0\0\0\0"), n
+
+
 class NativeLevenshtein:
-    """Batch edit distances via the C++ kernel."""
+    """Batch edit distances over Unicode codepoints via the C++ kernel."""
 
     def __init__(self, lib: ctypes.CDLL) -> None:
         self._lib = lib
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        intp = ctypes.POINTER(ctypes.c_int)
         lib.delphi_levenshtein.restype = ctypes.c_int
-        lib.delphi_levenshtein.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.delphi_levenshtein.argtypes = [u32p, ctypes.c_int, u32p, ctypes.c_int]
         lib.delphi_levenshtein_batch.restype = None
         lib.delphi_levenshtein_batch.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            u32p, ctypes.c_int, u32p, intp, intp, ctypes.c_int,
             ctypes.POINTER(ctypes.c_double)]
 
     @classmethod
@@ -42,20 +52,29 @@ class NativeLevenshtein:
         return cls(ctypes.CDLL(path))
 
     def distance(self, x: str, y: str) -> int:
-        return int(self._lib.delphi_levenshtein(x.encode(), y.encode()))
+        xa, lx = _u32(x)
+        ya, ly = _u32(y)
+        return int(self._lib.delphi_levenshtein(xa, lx, ya, ly))
 
     def batch_distance(self, x: str, ys: Sequence[object]) -> List[Optional[float]]:
         n = len(ys)
-        arr = (ctypes.c_char_p * n)()
-        valid = []
+        offs = (ctypes.c_int * n)()
+        lens = (ctypes.c_int * n)()
+        chunks = []
+        pos = 0
         for i, y in enumerate(ys):
             if y:
-                arr[i] = str(y).encode()
-                valid.append(True)
+                cp = str(y).encode("utf-32-le")
+                offs[i] = pos
+                lens[i] = len(cp) // 4
+                chunks.append(cp)
+                pos += lens[i]
             else:
-                arr[i] = None
-                valid.append(False)
+                offs[i] = 0
+                lens[i] = -1
+        flat_buf = b"".join(chunks) or b"\0\0\0\0"
+        flat = (ctypes.c_uint32 * max(pos, 1)).from_buffer_copy(flat_buf)
+        xa, lx = _u32(x)
         out = (ctypes.c_double * n)()
-        self._lib.delphi_levenshtein_batch(
-            x.encode(), ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), n, out)
-        return [float(out[i]) if valid[i] else None for i in range(n)]
+        self._lib.delphi_levenshtein_batch(xa, lx, flat, offs, lens, n, out)
+        return [float(out[i]) if lens[i] >= 0 else None for i in range(n)]

@@ -3,7 +3,10 @@
     python examples/adult.py [path-to-testdata]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pandas as pd
 

@@ -5,7 +5,10 @@ also runnable via `python bench.py`.
     python examples/flights.py [path-to-raha-testdata]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pandas as pd
 

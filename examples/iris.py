@@ -5,7 +5,10 @@ regressors and scored as RMSE/MAE against the clean data.
     python examples/iris.py [path-to-testdata]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import pandas as pd

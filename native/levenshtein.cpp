@@ -6,25 +6,25 @@
 // candidate class) is a single C call over the candidate batch, avoiding
 // per-pair Python dispatch.
 //
+// Distances are computed over Unicode codepoints (UTF-32 arrays prepared by
+// the ctypes wrapper), matching Python `str` semantics — NOT UTF-8 bytes.
+//
 // Build: make -C native   (produces native/build/libdelphi_native.so, loaded
 // via ctypes by delphi_tpu/utils/native.py)
 
 #include <algorithm>
-#include <cstring>
-#include <string>
+#include <cstdint>
 #include <vector>
 
 namespace {
 
-int levenshtein(const char* a, const char* b) {
-  const size_t la = std::strlen(a);
-  const size_t lb = std::strlen(b);
-  if (la == 0) return static_cast<int>(lb);
-  if (lb == 0) return static_cast<int>(la);
+int levenshtein(const uint32_t* a, int la, const uint32_t* b, int lb) {
+  if (la == 0) return lb;
+  if (lb == 0) return la;
 
-  const char* shorter = a;
-  const char* longer = b;
-  size_t ls = la, ll = lb;
+  const uint32_t* shorter = a;
+  const uint32_t* longer = b;
+  int ls = la, ll = lb;
   if (ls > ll) {
     std::swap(shorter, longer);
     std::swap(ls, ll);
@@ -32,12 +32,12 @@ int levenshtein(const char* a, const char* b) {
 
   std::vector<int> prev(ls + 1);
   std::vector<int> cur(ls + 1);
-  for (size_t j = 0; j <= ls; ++j) prev[j] = static_cast<int>(j);
+  for (int j = 0; j <= ls; ++j) prev[j] = j;
 
-  for (size_t i = 1; i <= ll; ++i) {
-    cur[0] = static_cast<int>(i);
-    const char ci = longer[i - 1];
-    for (size_t j = 1; j <= ls; ++j) {
+  for (int i = 1; i <= ll; ++i) {
+    cur[0] = i;
+    const uint32_t ci = longer[i - 1];
+    for (int j = 1; j <= ls; ++j) {
       const int del = prev[j] + 1;
       const int ins = cur[j - 1] + 1;
       const int sub = prev[j - 1] + (ci != shorter[j - 1] ? 1 : 0);
@@ -52,20 +52,25 @@ int levenshtein(const char* a, const char* b) {
 
 extern "C" {
 
-int delphi_levenshtein(const char* a, const char* b) {
+int delphi_levenshtein(const uint32_t* a, int la, const uint32_t* b, int lb) {
   if (a == nullptr || b == nullptr) return -1;
-  return levenshtein(a, b);
+  return levenshtein(a, la, b, lb);
 }
 
-// Distances from `x` to each of `ys` (null entries yield -1.0).
-void delphi_levenshtein_batch(const char* x, const char** ys, int n,
-                              double* out) {
+// Distances from `x` to each of n candidate strings packed back-to-back in
+// `ys_flat`; ys_len[i] < 0 marks a null entry (yields -1.0).
+void delphi_levenshtein_batch(const uint32_t* x, int lx,
+                              const uint32_t* ys_flat, const int* ys_off,
+                              const int* ys_len, int n, double* out) {
   if (x == nullptr) {
     for (int i = 0; i < n; ++i) out[i] = -1.0;
     return;
   }
   for (int i = 0; i < n; ++i) {
-    out[i] = ys[i] == nullptr ? -1.0 : static_cast<double>(levenshtein(x, ys[i]));
+    out[i] = ys_len[i] < 0
+                 ? -1.0
+                 : static_cast<double>(
+                       levenshtein(x, lx, ys_flat + ys_off[i], ys_len[i]));
   }
 }
 
