@@ -122,17 +122,9 @@ def _batch_levenshtein(x: str, ys: Sequence[Optional[Value]]) -> List[Optional[f
     return [float(_python_levenshtein(x, str(y))) if y else None for y in ys]
 
 
-_native = None
-_native_checked = False
-
-
 def _native_backend():
-    global _native, _native_checked
-    if not _native_checked:
-        _native_checked = True
-        try:
-            from delphi_tpu.utils.native import NativeLevenshtein
-            _native = NativeLevenshtein.load()
-        except Exception:
-            _native = None
-    return _native
+    try:
+        from delphi_tpu.utils.native import get_levenshtein
+        return get_levenshtein()
+    except Exception:
+        return None

@@ -15,6 +15,20 @@ import pandas as pd
 
 FEATURE_DIM = 1024
 
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a(value: str) -> int:
+    """FNV-1a over the UTF-32-LE bytes — identical to the native kernel
+    (native/qgram.cpp) and, unlike builtin `hash()`, unsalted: the same
+    input clusters identically across processes."""
+    h = _FNV_OFFSET
+    for b in value.encode("utf-32-le"):
+        h = ((h ^ b) * _FNV_PRIME) & _U64
+    return h
+
 
 def _qgrams(value: str, q: int):
     if len(value) > q:
@@ -24,22 +38,47 @@ def _qgrams(value: str, q: int):
         yield value
 
 
-def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
-    """Hashed bag-of-q-grams over the row's string values
-    (RepairMiscApi.scala:52-71 computes exact q-grams; we hash to a fixed
-    dimension which preserves the clustering geometry)."""
-    assert q > 0, f"`q` must be positive, but {q} got"
-    n = len(df)
-    out = np.zeros((n, FEATURE_DIM), dtype=np.float32)
+def _cell_values(df: pd.DataFrame):
+    """Yields (row_index, value_string) for every non-null cell."""
     cols = [df[c].tolist() for c in df.columns]
-    for i in range(n):
+    for i in range(len(df)):
         for col in cols:
             v = col[i]
             if v is None or (isinstance(v, float) and np.isnan(v)):
                 continue
-            for g in _qgrams(str(v), q):
-                out[i, hash(g) % FEATURE_DIM] += 1.0
+            yield i, str(v)
+
+
+def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
+    """Hashed bag-of-q-grams over the row's string values
+    (RepairMiscApi.scala:52-71 computes exact q-grams; we hash to a fixed
+    dimension which preserves the clustering geometry). Uses the native C++
+    kernel when built, else an identical-output Python path."""
+    assert q > 0, f"`q` must be positive, but {q} got"
+    n = len(df)
+
+    native = _native_qgram()
+    if native is not None:
+        rows: list = []
+        values: list = []
+        for i, v in _cell_values(df):
+            rows.append(i)
+            values.append(v)
+        return native.features(values, rows, n, q, FEATURE_DIM)
+
+    out = np.zeros((n, FEATURE_DIM), dtype=np.float32)
+    for i, v in _cell_values(df):
+        for g in _qgrams(v, q):
+            out[i, _fnv1a(g) % FEATURE_DIM] += 1.0
     return out
+
+
+def _native_qgram():
+    try:
+        from delphi_tpu.utils.native import get_qgram
+        return get_qgram()
+    except Exception:
+        return None
 
 
 @partial(jax.jit, static_argnames=("k", "n_iters"))

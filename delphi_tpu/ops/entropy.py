@@ -32,9 +32,13 @@ from delphi_tpu.ops.freq import FreqStats, Pair, _pallas_policy
 _PALLAS_ENTROPY_MIN_GROUPS = 1 << 16
 
 
-def _use_pallas_entropy(n_groups: int) -> bool:
+def _use_pallas_entropy(n_groups: int, n_rows: int) -> bool:
+    from delphi_tpu.ops.pallas_kernels import entropy_pallas_supported
+
     policy = _pallas_policy()
     if policy in ("0", "off", "never"):
+        return False
+    if not entropy_pallas_supported(n_groups, n_rows):
         return False
     if policy in ("1", "on", "force"):
         return True
@@ -46,7 +50,7 @@ def _entropy_with_correction(counts: np.ndarray, n_rows: int, ub_domain: int) \
         -> float:
     """-sum (c/n) log2 (c/n) over observed groups, plus the missing-mass
     correction for unobserved/filtered groups."""
-    if _use_pallas_entropy(counts.size):
+    if _use_pallas_entropy(counts.size, n_rows):
         from delphi_tpu.ops.pallas_kernels import pallas_entropy_terms
 
         h, total, n_observed = pallas_entropy_terms(counts, n_rows)

@@ -146,23 +146,28 @@ def compute_freq_stats(table: EncodedTable,
     singles_arr = np.asarray(_batched_single_counts(codes, v_pad))
     singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
 
+    # Per-pair routing: pairs whose vocabularies fit the MXU kernel's VMEM/
+    # exactness guards go to pallas (ops/pallas_kernels.py — one-hot matmul
+    # contracting row tiles into a [Vx, Vy] accumulator, columns sliced on
+    # device); the rest run through the batched XLA bincount.
     pair_mats: Dict[Pair, np.ndarray] = {}
-    if pairs and use_pallas_pair_counts(v_pad, v_pad, table.n_rows):
-        # MXU one-hot-matmul kernel (ops/pallas_kernels.py): per-pair calls,
-        # each contracting row tiles into a [Vx, Vy] VMEM accumulator.
-        # Columns are sliced on device — no host round-trip.
+    mxu_pairs = [p for p in pairs if use_pallas_pair_counts(
+        vocab_sizes[p[0]], vocab_sizes[p[1]], table.n_rows)]
+    xla_pairs = [p for p in pairs if p not in mxu_pairs]
+
+    if mxu_pairs:
         from delphi_tpu.ops.pallas_kernels import pallas_pair_counts
 
-        for x, y in pairs:
+        for x, y in mxu_pairs:
             pair_mats[(x, y)] = pallas_pair_counts(
                 codes[:, name_to_idx[x]], codes[:, name_to_idx[y]],
                 vocab_sizes[x], vocab_sizes[y])
-    elif pairs:
-        xi = jnp.asarray([name_to_idx[x] for x, _ in pairs], dtype=jnp.int32)
-        yi = jnp.asarray([name_to_idx[y] for _, y in pairs], dtype=jnp.int32)
+    if xla_pairs:
+        xi = jnp.asarray([name_to_idx[x] for x, _ in xla_pairs], dtype=jnp.int32)
+        yi = jnp.asarray([name_to_idx[y] for _, y in xla_pairs], dtype=jnp.int32)
         flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))
         stride = v_pad + 1
-        for p, (x, y) in enumerate(pairs):
+        for p, (x, y) in enumerate(xla_pairs):
             m = flat[p].reshape(stride, stride)
             pair_mats[(x, y)] = m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
 

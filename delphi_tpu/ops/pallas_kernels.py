@@ -149,6 +149,45 @@ def pallas_supported(vx: int, vy: int, n_rows: int = 0) -> bool:
 # Entropy partial sums: single-pass VPU reduction
 # ---------------------------------------------------------------------------
 
+# One (1, n_pad) VMEM block per call; cap well under the ~16 MB budget.
+_ENTROPY_MAX_GROUPS = 1 << 21
+
+
+def entropy_pallas_supported(n_groups: int, n_rows: int) -> bool:
+    """f32 exactness (total must represent n_rows exactly, same 2^24 bound as
+    the pair counter) and single-block VMEM fit."""
+    return n_rows < (1 << 24) and n_groups <= _ENTROPY_MAX_GROUPS
+
+
+def _entropy_kernel(c_ref, n_ref, out_ref):
+    c = c_ref[:]
+    n_rows = n_ref[0, 0]
+    nz = c > 0.0
+    p = jnp.where(nz, c, 1.0) / n_rows
+    h = -jnp.sum(jnp.where(nz, p * jnp.log2(p), 0.0)).reshape(1, 1)
+    tot = jnp.sum(c).reshape(1, 1)
+    cnt = jnp.sum(nz.astype(jnp.float32)).reshape(1, 1)
+    out_ref[:] = jnp.concatenate(
+        [h, tot, cnt, jnp.zeros((1, 5), jnp.float32)], axis=1)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _entropy_call(buf: jnp.ndarray, n_rows_arr: jnp.ndarray,
+                  interpret: bool) -> jnp.ndarray:
+    """Jitted (cached per n_pad shape): n_rows rides in SMEM so changing row
+    counts never retraces."""
+    return pl.pallas_call(
+        _entropy_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 8), jnp.float32),
+        interpret=interpret,
+    )(buf, n_rows_arr)
+
+
 def pallas_entropy_terms(counts: np.ndarray, n_rows: int) \
         -> Tuple[float, float, int]:
     """(h_observed, total_observed, n_observed_groups) for one count vector —
@@ -159,29 +198,8 @@ def pallas_entropy_terms(counts: np.ndarray, n_rows: int) \
     buf = np.zeros((1, n_pad), dtype=np.float32)
     buf[0, : flat.size] = flat
 
-    interpret = _interpret_mode()
-    out = pl.pallas_call(
-        _entropy_kernel_factory(float(n_rows)),
-        in_specs=[pl.BlockSpec((1, n_pad),
-                               memory_space=pltpu.ANY if interpret else pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, 8),
-                               memory_space=pltpu.ANY if interpret else pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, 8), jnp.float32),
-        interpret=interpret,
-    )(jnp.asarray(buf))
-    out = np.asarray(out)
+    out = np.asarray(_entropy_call(
+        jnp.asarray(buf),
+        jnp.asarray([[float(n_rows)]], dtype=jnp.float32),
+        _interpret_mode()))
     return float(out[0, 0]), float(out[0, 1]), int(out[0, 2])
-
-
-def _entropy_kernel_factory(n_rows: float):
-    def kernel(c_ref, out_ref):
-        c = c_ref[:]
-        nz = c > 0.0
-        p = jnp.where(nz, c, 1.0) / n_rows
-        h = -jnp.sum(jnp.where(nz, p * jnp.log2(p), 0.0)).reshape(1, 1)
-        tot = jnp.sum(c).reshape(1, 1)
-        cnt = jnp.sum(nz.astype(jnp.float32)).reshape(1, 1)
-        out_ref[:] = jnp.concatenate(
-            [h, tot, cnt, jnp.zeros((1, 5), jnp.float32)], axis=1)
-
-    return kernel

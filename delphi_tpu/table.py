@@ -30,6 +30,15 @@ KIND_FRACTIONAL = "fractional"
 
 NULL_CODE = -1
 
+def _native_dict_encoder():
+    """Native C++ first-appearance dictionary encoder (native/dict_encode.cpp),
+    None when the library isn't built — callers use pandas.factorize then."""
+    try:
+        from delphi_tpu.utils.native import get_dict_encoder
+        return get_dict_encoder()
+    except Exception:
+        return None
+
 
 def column_kind(series: pd.Series) -> str:
     dt = series.dtype
@@ -96,11 +105,15 @@ class EncodedColumn:
 def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColumn:
     kind = column_kind(series)
     strings = _value_strings(series, kind)
-    codes, uniques = pd.factorize(strings, use_na_sentinel=True)
+    encoder = _native_dict_encoder()
+    if encoder is not None:
+        codes, uniques = encoder.encode(strings.tolist())
+    else:
+        codes, uniques = pd.factorize(strings, use_na_sentinel=True)
     col = EncodedColumn(
         name=name or str(series.name),
         kind=kind,
-        codes=codes.astype(np.int32),
+        codes=np.asarray(codes, dtype=np.int32),
         vocab=np.asarray(uniques, dtype=object),
     )
     if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):

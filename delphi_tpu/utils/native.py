@@ -1,13 +1,19 @@
 """ctypes bindings for the native C++ helpers (see `native/`).
 
 The shared library provides batch Levenshtein distance (the hot op of
-cost-weighted PMF computation) and is loaded lazily; callers fall back to
-Python implementations when the library has not been built.
+cost-weighted PMF computation), dictionary encoding (the ingestion hot
+path), and hashed q-gram featurization (input splitting). Everything is
+loaded lazily; callers fall back to Python implementations when the library
+has not been built.
 """
 
 import ctypes
 import os
-from typing import List, Optional, Sequence
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
 
 _LIB_NAMES = ("libdelphi_native.so",)
 
@@ -20,6 +26,36 @@ def _find_library() -> Optional[str]:
             if os.path.exists(path):
                 return path
     return None
+
+
+@lru_cache(maxsize=None)
+def _shared_lib() -> Optional[ctypes.CDLL]:
+    """The one dlopen of libdelphi_native.so shared by all bindings."""
+    path = _find_library()
+    if path is None:
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None
+
+
+@lru_cache(maxsize=None)
+def get_levenshtein() -> Optional["NativeLevenshtein"]:
+    lib = _shared_lib()
+    return NativeLevenshtein(lib) if lib is not None else None
+
+
+@lru_cache(maxsize=None)
+def get_dict_encoder() -> Optional["NativeDictEncoder"]:
+    lib = _shared_lib()
+    return NativeDictEncoder(lib) if lib is not None else None
+
+
+@lru_cache(maxsize=None)
+def get_qgram() -> Optional["NativeQGram"]:
+    lib = _shared_lib()
+    return NativeQGram(lib) if lib is not None else None
 
 
 def _u32(s: str) -> "ctypes.Array":
@@ -46,10 +82,7 @@ class NativeLevenshtein:
 
     @classmethod
     def load(cls) -> Optional["NativeLevenshtein"]:
-        path = _find_library()
-        if path is None:
-            return None
-        return cls(ctypes.CDLL(path))
+        return get_levenshtein()
 
     def distance(self, x: str, y: str) -> int:
         xa, lx = _u32(x)
@@ -78,3 +111,102 @@ class NativeLevenshtein:
         out = (ctypes.c_double * n)()
         self._lib.delphi_levenshtein_batch(xa, lx, flat, offs, lens, n, out)
         return [float(out[i]) if lens[i] >= 0 else None for i in range(n)]
+
+
+class NativeDictEncoder:
+    """First-appearance-order dictionary encoding via the C++ hash table —
+    bit-compatible with `pandas.factorize(use_na_sentinel=True)`."""
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.delphi_dict_encode.restype = ctypes.c_int
+        lib.delphi_dict_encode.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+
+    @classmethod
+    def load(cls) -> Optional["NativeDictEncoder"]:
+        return get_dict_encoder()
+
+    def encode(self, values: Sequence[Optional[str]]) \
+            -> Tuple[np.ndarray, np.ndarray]:
+        """(codes int32[n] with NULL=-1, vocab object[n_distinct])."""
+        n = len(values)
+        if n == 0:
+            return np.zeros(0, dtype=np.int32), np.zeros(0, dtype=object)
+        is_null = np.zeros(n, dtype=np.uint8)
+        chunks: List[bytes] = []
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        pos = 0
+        for i, v in enumerate(values):
+            # null iff pandas would treat it as NA (None, NaN, pd.NA) —
+            # factorize(use_na_sentinel=True) parity
+            if v is None or v is pd.NA or (isinstance(v, float) and v != v):
+                is_null[i] = 1
+            else:
+                b = str(v).encode("utf-8")
+                chunks.append(b)
+                pos += len(b)
+            offsets[i + 1] = pos
+        flat = b"".join(chunks)
+        codes = np.zeros(n, dtype=np.int32)
+        first_idx = np.zeros(n, dtype=np.int64)
+        n_distinct = self._lib.delphi_dict_encode(
+            flat, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            is_null.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            first_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if n_distinct < 0:
+            raise RuntimeError("native dict encode failed")
+        vocab = np.empty(n_distinct, dtype=object)
+        for c in range(n_distinct):
+            vocab[c] = values[first_idx[c]]
+        return codes, vocab
+
+
+class NativeQGram:
+    """Hashed bag-of-q-grams (FNV-1a over codepoints) via the C++ kernel."""
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.delphi_qgram_features.restype = None
+        lib.delphi_qgram_features.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float)]
+
+    @classmethod
+    def load(cls) -> Optional["NativeQGram"]:
+        return get_qgram()
+
+    def features(self, values: Sequence[Optional[str]],
+                 row_of_value: Sequence[int], n_rows: int, q: int,
+                 feature_dim: int) -> np.ndarray:
+        n_values = len(values)
+        offs = np.zeros(n_values, dtype=np.int64)
+        lens = np.zeros(n_values, dtype=np.int64)
+        rows = np.asarray(row_of_value, dtype=np.int64)
+        chunks: List[bytes] = []
+        pos = 0
+        for i, v in enumerate(values):
+            if v is None:
+                lens[i] = -1
+            else:
+                cp = v.encode("utf-32-le")
+                offs[i] = pos
+                lens[i] = len(cp) // 4
+                chunks.append(cp)
+                pos += lens[i]
+        flat_buf = b"".join(chunks) or b"\0\0\0\0"
+        flat = np.frombuffer(flat_buf, dtype=np.uint32).copy()
+        out = np.zeros((n_rows, feature_dim), dtype=np.float32)
+        self._lib.delphi_qgram_features(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_values, q, feature_dim,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out

@@ -35,8 +35,7 @@ repaired_df = delphi.repair \
 # Precision: correct repairs / repairs performed; recall: correct / all errors
 pdf = repaired_df.merge(clean, on=["tid", "attribute"], how="inner")
 truth = pd.read_csv(f"{TESTDATA}/hospital_error_cells.csv", dtype=str)
-rdf = truth.merge(repaired_df, on=["tid", "attribute"], how="left") \
-    .merge(clean, on=["tid", "attribute"], how="left")
+rdf = truth.merge(repaired_df, on=["tid", "attribute"], how="left")
 
 nse = lambda a, b: (a == b) | (a.isna() & b.isna())
 precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean())

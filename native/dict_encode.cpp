@@ -1,0 +1,90 @@
+// Native dictionary encoder for delphi_tpu's ingestion path.
+//
+// The reference's ingestion tier is the Scala/Spark engine (string columns
+// become grouped/discretized views, RepairApi.scala:126-169); our columnar
+// core instead dictionary-encodes every attribute into int32 codes before
+// anything touches the device (delphi_tpu/table.py). This kernel is the
+// native fast path for that encode: FNV-1a hashing + open addressing over
+// the column's UTF-8 bytes, emitting codes in FIRST-APPEARANCE order —
+// exactly the order pandas.factorize produces, so the Python fallback and
+// the native path yield identical vocabularies.
+//
+// Build: make -C native
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline uint64_t fnv1a(const char* data, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+inline uint64_t next_pow2(uint64_t v) {
+  uint64_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Dictionary-encode n UTF-8 strings packed back-to-back in `flat` with
+// offsets[i]..offsets[i+1] per value; is_null[i] != 0 marks NULL (code -1).
+// Fills codes[n] and first_idx (row index of each distinct value's first
+// appearance, in code order). Returns the vocabulary size, or -1 on error.
+int delphi_dict_encode(const char* flat, const int64_t* offsets,
+                       const uint8_t* is_null, int64_t n, int32_t* codes,
+                       int64_t* first_idx) {
+  if (flat == nullptr || offsets == nullptr || codes == nullptr ||
+      first_idx == nullptr) {
+    return -1;
+  }
+
+  const uint64_t cap = next_pow2(static_cast<uint64_t>(n) * 2 + 8);
+  const uint64_t mask = cap - 1;
+  // slot -> row index of the representative value; -1 = empty
+  std::vector<int64_t> slot_row(cap, -1);
+  std::vector<int32_t> slot_code(cap, -1);
+
+  int32_t next_code = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (is_null != nullptr && is_null[i]) {
+      codes[i] = -1;
+      continue;
+    }
+    const char* s = flat + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    uint64_t slot = fnv1a(s, len) & mask;
+    for (;;) {
+      const int64_t row = slot_row[slot];
+      if (row < 0) {  // new distinct value
+        slot_row[slot] = i;
+        slot_code[slot] = next_code;
+        first_idx[next_code] = i;
+        codes[i] = next_code;
+        ++next_code;
+        break;
+      }
+      const int64_t rlen = offsets[row + 1] - offsets[row];
+      if (rlen == len && std::memcmp(flat + offsets[row], s, len) == 0) {
+        codes[i] = slot_code[slot];
+        break;
+      }
+      slot = (slot + 1) & mask;  // linear probe
+    }
+  }
+  return next_code;
+}
+
+}  // extern "C"

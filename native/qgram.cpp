@@ -1,0 +1,67 @@
+// Native hashed bag-of-q-grams featurizer.
+//
+// The reference featurizes rows for input splitting with a CountVectorizer
+// over exact q-grams feeding Spark MLlib KMeans (RepairMiscApi.scala:52-71,
+// 104-152). Our design hashes q-grams into a fixed feature dimension so the
+// downstream k-means runs with static shapes on device (ops/cluster.py);
+// this kernel builds that [n_rows, feature_dim] matrix in one pass.
+//
+// Q-grams are windows over Unicode CODEPOINTS (UTF-32 units prepared by the
+// ctypes wrapper), matching Python `str` slicing semantics, hashed with
+// FNV-1a over the little-endian 4-byte units — the Python fallback uses the
+// same hash, so native and fallback produce identical features (and, unlike
+// Python's salted `hash()`, the same clusters across processes).
+//
+// Build: make -C native
+
+#include <cstdint>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline uint64_t fnv1a_u32(const uint32_t* data, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    uint32_t cp = data[i];
+    for (int b = 0; b < 4; ++b) {
+      h ^= (cp & 0xffu);
+      h *= kFnvPrime;
+      cp >>= 8;
+    }
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Accumulate hashed q-gram counts for n_values strings (UTF-32, packed in
+// ys_flat with offsets/lens) into out[row_of_value[v] * feature_dim + h].
+// A value shorter than or equal to q contributes itself as a single gram
+// (matching RepairMiscApi.scala:60-66: `if (length > q) sliding else self`).
+void delphi_qgram_features(const uint32_t* ys_flat, const int64_t* ys_off,
+                           const int64_t* ys_len, const int64_t* row_of_value,
+                           int64_t n_values, int64_t q, int64_t feature_dim,
+                           float* out) {
+  if (ys_flat == nullptr || out == nullptr || q <= 0 || feature_dim <= 0) {
+    return;
+  }
+  for (int64_t v = 0; v < n_values; ++v) {
+    const int64_t len = ys_len[v];
+    if (len < 0) continue;  // NULL value
+    const uint32_t* s = ys_flat + ys_off[v];
+    float* row = out + row_of_value[v] * feature_dim;
+    if (len > q) {
+      for (int64_t i = 0; i + q <= len; ++i) {
+        row[fnv1a_u32(s + i, q) % static_cast<uint64_t>(feature_dim)] += 1.0f;
+      }
+    } else {
+      row[fnv1a_u32(s, len) % static_cast<uint64_t>(feature_dim)] += 1.0f;
+    }
+  }
+}
+
+}  // extern "C"
