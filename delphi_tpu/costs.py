@@ -13,6 +13,8 @@ from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from delphi_tpu.utils.native import get_levenshtein
+
 Value = Union[str, int, float]
 
 
@@ -109,22 +111,16 @@ def _python_levenshtein(x: str, y: str) -> int:
 
 
 def _levenshtein_distance(x: str, y: str) -> int:
-    native = _native_backend()
+    native = get_levenshtein()
     if native is not None:
         return native.distance(x, y)
     return _python_levenshtein(x, y)
 
 
 def _batch_levenshtein(x: str, ys: Sequence[Optional[Value]]) -> List[Optional[float]]:
-    native = _native_backend()
+    native = get_levenshtein()
     if native is not None:
         return native.batch_distance(x, ys)
     return [float(_python_levenshtein(x, str(y))) if y else None for y in ys]
 
 
-def _native_backend():
-    try:
-        from delphi_tpu.utils.native import get_levenshtein
-        return get_levenshtein()
-    except Exception:
-        return None

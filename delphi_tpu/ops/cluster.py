@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from delphi_tpu.utils.native import get_qgram
+
 FEATURE_DIM = 1024
 
 _FNV_OFFSET = 0xCBF29CE484222325
@@ -25,7 +27,7 @@ def _fnv1a(value: str) -> int:
     (native/qgram.cpp) and, unlike builtin `hash()`, unsalted: the same
     input clusters identically across processes."""
     h = _FNV_OFFSET
-    for b in value.encode("utf-32-le"):
+    for b in value.encode("utf-32-le", "surrogatepass"):
         h = ((h ^ b) * _FNV_PRIME) & _U64
     return h
 
@@ -57,7 +59,7 @@ def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
     assert q > 0, f"`q` must be positive, but {q} got"
     n = len(df)
 
-    native = _native_qgram()
+    native = get_qgram()
     if native is not None:
         rows: list = []
         values: list = []
@@ -71,14 +73,6 @@ def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
         for g in _qgrams(v, q):
             out[i, _fnv1a(g) % FEATURE_DIM] += 1.0
     return out
-
-
-def _native_qgram():
-    try:
-        from delphi_tpu.utils.native import get_qgram
-        return get_qgram()
-    except Exception:
-        return None
 
 
 @partial(jax.jit, static_argnames=("k", "n_iters"))

@@ -153,7 +153,8 @@ def compute_freq_stats(table: EncodedTable,
     pair_mats: Dict[Pair, np.ndarray] = {}
     mxu_pairs = [p for p in pairs if use_pallas_pair_counts(
         vocab_sizes[p[0]], vocab_sizes[p[1]], table.n_rows)]
-    xla_pairs = [p for p in pairs if p not in mxu_pairs]
+    mxu_set = set(mxu_pairs)
+    xla_pairs = [p for p in pairs if p not in mxu_set]
 
     if mxu_pairs:
         from delphi_tpu.ops.pallas_kernels import pallas_pair_counts

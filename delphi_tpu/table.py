@@ -30,16 +30,6 @@ KIND_FRACTIONAL = "fractional"
 
 NULL_CODE = -1
 
-def _native_dict_encoder():
-    """Native C++ first-appearance dictionary encoder (native/dict_encode.cpp),
-    None when the library isn't built — callers use pandas.factorize then."""
-    try:
-        from delphi_tpu.utils.native import get_dict_encoder
-        return get_dict_encoder()
-    except Exception:
-        return None
-
-
 def column_kind(series: pd.Series) -> str:
     dt = series.dtype
     if pd.api.types.is_bool_dtype(dt):
@@ -105,7 +95,8 @@ class EncodedColumn:
 def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColumn:
     kind = column_kind(series)
     strings = _value_strings(series, kind)
-    encoder = _native_dict_encoder()
+    from delphi_tpu.utils.native import get_dict_encoder
+    encoder = get_dict_encoder()
     if encoder is not None:
         codes, uniques = encoder.encode(strings.tolist())
     else:

@@ -40,28 +40,41 @@ def _shared_lib() -> Optional[ctypes.CDLL]:
         return None
 
 
+# The get_* accessors own the None-on-unavailable contract: a missing or
+# symbol-incomplete library yields None, never an exception, so callers can
+# fall back to the Python path with a plain `get_*()` call.
+
 @lru_cache(maxsize=None)
 def get_levenshtein() -> Optional["NativeLevenshtein"]:
     lib = _shared_lib()
-    return NativeLevenshtein(lib) if lib is not None else None
+    try:
+        return NativeLevenshtein(lib) if lib is not None else None
+    except Exception:
+        return None
 
 
 @lru_cache(maxsize=None)
 def get_dict_encoder() -> Optional["NativeDictEncoder"]:
     lib = _shared_lib()
-    return NativeDictEncoder(lib) if lib is not None else None
+    try:
+        return NativeDictEncoder(lib) if lib is not None else None
+    except Exception:
+        return None
 
 
 @lru_cache(maxsize=None)
 def get_qgram() -> Optional["NativeQGram"]:
     lib = _shared_lib()
-    return NativeQGram(lib) if lib is not None else None
+    try:
+        return NativeQGram(lib) if lib is not None else None
+    except Exception:
+        return None
 
 
 def _u32(s: str) -> "ctypes.Array":
     """str -> uint32 codepoint array (Python `str` semantics, not UTF-8
     bytes — 'café' has length 4)."""
-    buf = s.encode("utf-32-le")
+    buf = s.encode("utf-32-le", "surrogatepass")
     n = len(buf) // 4
     return (ctypes.c_uint32 * max(n, 1)).from_buffer_copy(buf or b"\0\0\0\0"), n
 
@@ -97,7 +110,7 @@ class NativeLevenshtein:
         pos = 0
         for i, y in enumerate(ys):
             if y:
-                cp = str(y).encode("utf-32-le")
+                cp = str(y).encode("utf-32-le", "surrogatepass")
                 offs[i] = pos
                 lens[i] = len(cp) // 4
                 chunks.append(cp)
@@ -145,7 +158,7 @@ class NativeDictEncoder:
             if v is None or v is pd.NA or (isinstance(v, float) and v != v):
                 is_null[i] = 1
             else:
-                b = str(v).encode("utf-8")
+                b = str(v).encode("utf-8", "surrogatepass")
                 chunks.append(b)
                 pos += len(b)
             offsets[i + 1] = pos
@@ -194,7 +207,7 @@ class NativeQGram:
             if v is None:
                 lens[i] = -1
             else:
-                cp = v.encode("utf-32-le")
+                cp = v.encode("utf-32-le", "surrogatepass")
                 offs[i] = pos
                 lens[i] = len(cp) // 4
                 chunks.append(cp)

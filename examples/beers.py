@@ -1,0 +1,51 @@
+"""Raha beers repair with ground-truth error cells
+(reference resources/examples/beers.py): a known-hard dataset — the reference
+transcript records P/R/F1 = 0.0551. Only the 'state' attribute is targeted;
+the other erroneous attrs carry format errors a categorical repairer cannot
+reproduce.
+
+    python examples/beers.py [path-to-raha-testdata]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pandas as pd
+
+from delphi_tpu import delphi
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata/raha"
+
+beers = pd.read_csv(f"{TESTDATA}/beers.csv", dtype=str)
+clean = pd.read_csv(f"{TESTDATA}/beers_clean.csv", dtype=str)
+delphi.register_table("beers", beers)
+
+flat = delphi.misc.options({"table_name": "beers", "row_id": "index"}).flatten()
+merged = flat.merge(clean, on=["index", "attribute"], how="inner")
+neq = ~((merged["value"] == merged["correct_val"])
+        | (merged["value"].isna() & merged["correct_val"].isna()))
+delphi.register_table(
+    "error_cells_ground_truth",
+    merged[neq][["index", "attribute"]].reset_index(drop=True))
+
+repaired_df = delphi.repair \
+    .setTableName("beers") \
+    .setRowId("index") \
+    .setErrorCells("error_cells_ground_truth") \
+    .setTargets(["state"]) \
+    .setDiscreteThreshold(600) \
+    .run()
+
+pdf = repaired_df.merge(clean, on=["index", "attribute"], how="inner")
+gt = delphi.table("error_cells_ground_truth")
+rdf = gt[gt["attribute"] == "state"] \
+    .merge(repaired_df, on=["index", "attribute"], how="left") \
+    .merge(clean, on=["index", "attribute"], how="left")
+
+nse = lambda a, b: (a == b) | (a.isna() & b.isna())
+precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean())
+recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean())
+f1 = 2 * precision * recall / (precision + recall + 1e-4)
+print(f"Precision={precision} Recall={recall} F1={f1}")

@@ -1,0 +1,56 @@
+"""Mixed discrete/continuous repair on boston
+(reference resources/examples/boston.py): detect errors with the default
+detectors, repair discrete attrs (scored as precision/recall) and continuous
+attrs (scored as RMSE/MAE) against boston_clean.
+
+    python examples/boston.py [path-to-testdata]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu import delphi
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata"
+
+# The reference casts a subset of columns to numeric types via an explicit
+# schema (resources/examples/boston.py: boston_schema); mirror that here.
+CONTINUOUS = ["CRIM", "RM", "DIS", "B", "LSTAT"]
+INTEGRAL = ["ZN", "TAX"]
+
+boston = pd.read_csv(f"{TESTDATA}/boston.csv", dtype=str)
+boston["tid"] = boston["tid"].astype(int)
+for c in CONTINUOUS:
+    boston[c] = boston[c].astype(float)
+for c in INTEGRAL:
+    boston[c] = boston[c].astype("Int64")
+clean = pd.read_csv(f"{TESTDATA}/boston_clean.csv", dtype=str)
+clean["tid"] = clean["tid"].astype(int)
+delphi.register_table("boston", boston)
+
+repaired_df = delphi.repair \
+    .setTableName("boston") \
+    .setRowId("tid") \
+    .setDiscreteThreshold(30) \
+    .run()
+
+pdf = repaired_df.merge(clean, on=["tid", "attribute"], how="inner")
+
+is_discrete = ~pdf["attribute"].isin(["CRIM", "LSTAT"])
+discrete = pdf[is_discrete]
+nse = lambda a, b: (a.astype(str) == b.astype(str)) | (a.isna() & b.isna())
+hits = nse(discrete["repaired"], discrete["correct_val"])
+precision = recall = float(hits.mean()) if len(discrete) else float("nan")
+f1 = (2 * precision * recall) / (precision + recall) if precision + recall else 0.0
+print(f"Precision={precision} Recall={recall} F1={f1}")
+
+continuous = pdf[~is_discrete]
+err = continuous["correct_val"].astype(float) - continuous["repaired"].astype(float)
+rmse = float(np.sqrt((err ** 2).mean()))
+mae = float(err.abs().mean())
+print(f"RMSE={rmse} MAE={mae} RMSE/MAE={rmse / mae}")

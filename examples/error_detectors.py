@@ -1,0 +1,99 @@
+"""Tour of every built-in error detector
+(reference resources/examples/error-detectors.py): each detector runs in
+`detect_errors_only` mode and prints the first few detected cells.
+
+    python examples/error_detectors.py [path-to-testdata]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pandas as pd
+
+from delphi_tpu import delphi
+from delphi_tpu.errors import (
+    ConstraintErrorDetector,
+    DomainValues,
+    GaussianOutlierErrorDetector,
+    LOFOutlierErrorDetector,
+    NullErrorDetector,
+    RegExErrorDetector,
+    ScikitLearnBackedErrorDetector,
+)
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata"
+
+delphi.register_table("adult", pd.read_csv(f"{TESTDATA}/adult.csv", dtype=str))
+delphi.register_table("hospital", pd.read_csv(f"{TESTDATA}/hospital.csv", dtype=str))
+
+boston = pd.read_csv(f"{TESTDATA}/boston.csv", dtype=str)
+boston["tid"] = boston["tid"].astype(int)
+for c in ["CRIM", "RM", "DIS", "B", "LSTAT"]:
+    boston[c] = boston[c].astype(float)
+for c in ["ZN", "TAX"]:
+    boston[c] = boston[c].astype("Int64")
+delphi.register_table("boston", boston)
+
+
+def show(title, df):
+    print(f"--- {title}: {len(df)} cells")
+    print(df.head(3).to_string(index=False))
+
+
+show("NullErrorDetector", delphi.repair
+     .setTableName("hospital").setRowId("tid")
+     .setErrorDetectors([NullErrorDetector()])
+     .run(detect_errors_only=True))
+
+show("DomainValues", delphi.repair
+     .setTableName("adult").setRowId("tid")
+     .setErrorDetectors([DomainValues(attr="Sex", values=["Male", "Female"])])
+     .run(detect_errors_only=True))
+
+show("DomainValues(autofill)", delphi.repair
+     .setTableName("hospital").setRowId("tid")
+     .setErrorDetectors([
+         DomainValues(attr=c, autofill=True, min_count_thres=12)
+         for c in ["MeasureCode", "ZipCode", "City"]])
+     .run(detect_errors_only=True))
+
+show("RegExErrorDetector", delphi.repair
+     .setTableName("hospital").setRowId("tid")
+     .setErrorDetectors([RegExErrorDetector(attr="ZipCode", regex="\\d\\d\\d\\d\\d")])
+     .run(detect_errors_only=True))
+
+targets = ["City", "HospitalName", "Address1", "CountyName"]
+show("ConstraintErrorDetector(path)", delphi.repair
+     .setTableName("hospital").setRowId("tid").setTargets(targets)
+     .setErrorDetectors([ConstraintErrorDetector(
+         constraint_path=f"{TESTDATA}/hospital_constraints.txt")])
+     .run(detect_errors_only=True))
+
+show("ConstraintErrorDetector(simple)", delphi.repair
+     .setTableName("hospital").setRowId("tid").setTargets(targets)
+     .setErrorDetectors([ConstraintErrorDetector(
+         constraints="City->CountyName;HospitalName->Address1")])
+     .run(detect_errors_only=True))
+
+show("GaussianOutlierErrorDetector", delphi.repair
+     .setTableName("boston").setRowId("tid")
+     .setErrorDetectors([GaussianOutlierErrorDetector(approx_enabled=False)])
+     .run(detect_errors_only=True))
+
+show("LOFOutlierErrorDetector", delphi.repair
+     .setTableName("boston").setRowId("tid")
+     .setErrorDetectors([LOFOutlierErrorDetector()])
+     .run(detect_errors_only=True))
+
+try:
+    from sklearn.neighbors import LocalOutlierFactor
+
+    show("ScikitLearnBackedErrorDetector", delphi.repair
+         .setTableName("boston").setRowId("tid")
+         .setErrorDetectors([ScikitLearnBackedErrorDetector(
+             lambda: LocalOutlierFactor(novelty=False))])
+         .run(detect_errors_only=True))
+except ImportError:
+    print("--- ScikitLearnBackedErrorDetector: sklearn not available, skipped")

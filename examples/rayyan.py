@@ -1,0 +1,49 @@
+"""Raha rayyan repair with ground-truth error cells
+(reference resources/examples/rayyan.py): a known-failure dataset — the
+reference transcript records P/R/F1 = 0.0 (free-text attributes no
+categorical model can repair).
+
+    python examples/rayyan.py [path-to-raha-testdata]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pandas as pd
+
+from delphi_tpu import delphi
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata/raha"
+
+# The clean file carries Spark-style backslash-escaped quotes.
+rayyan = pd.read_csv(f"{TESTDATA}/rayyan.csv", dtype=str, escapechar="\\")
+clean = pd.read_csv(f"{TESTDATA}/rayyan_clean.csv", dtype=str, escapechar="\\")
+delphi.register_table("rayyan", rayyan)
+
+flat = delphi.misc.options({"table_name": "rayyan", "row_id": "id"}).flatten()
+merged = flat.merge(clean, on=["id", "attribute"], how="inner")
+neq = ~((merged["value"] == merged["correct_val"])
+        | (merged["value"].isna() & merged["correct_val"].isna()))
+delphi.register_table(
+    "error_cells_ground_truth",
+    merged[neq][["id", "attribute"]].reset_index(drop=True))
+
+repaired_df = delphi.repair \
+    .setTableName("rayyan") \
+    .setRowId("id") \
+    .setErrorCells("error_cells_ground_truth") \
+    .setDiscreteThreshold(400) \
+    .run()
+
+pdf = repaired_df.merge(clean, on=["id", "attribute"], how="inner")
+rdf = delphi.table("error_cells_ground_truth") \
+    .merge(repaired_df, on=["id", "attribute"], how="left") \
+    .merge(clean, on=["id", "attribute"], how="left")
+
+nse = lambda a, b: (a == b) | (a.isna() & b.isna())
+precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean()) if len(pdf) else 0.0
+recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean())
+f1 = 2 * precision * recall / (precision + recall + 1e-4)
+print(f"Precision={precision} Recall={recall} F1={f1}")
