@@ -16,7 +16,7 @@ DataFrames in and out are pandas.
 import copy
 import heapq
 from collections import namedtuple
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -398,7 +398,8 @@ class RepairModel:
 
     def _repair_by_nearest_values(self, repair_base_df: pd.DataFrame,
                                   error_cells_df: pd.DataFrame,
-                                  target_columns: List[str]) \
+                                  target_columns: List[str],
+                                  integral_columns: Set[str]) \
             -> Tuple[pd.DataFrame, pd.DataFrame]:
         assert self.cf is not None
         cf_targets = self.cf.targets
@@ -408,8 +409,11 @@ class RepairModel:
             return error_cells_df, self._empty_repaired_cells_frame()
 
         merge_threshold = self._get_option_value(*self._opt_merge_threshold)
+        # Integral attrs must stringify as ints ('100', not '100.0' from the
+        # NULL-padded float view) so distances match their current_value form.
         domains = {
-            c: [str(v) for v in repair_base_df[c].dropna().unique()]
+            c: [str(int(v)) if c in integral_columns else str(v)
+                for v in repair_base_df[c].dropna().unique()]
             for c in targets
         }
 
@@ -436,7 +440,8 @@ class RepairModel:
         return error_df, repaired_df
 
     def _repair_by_rules(self, repair_base_df: pd.DataFrame,
-                         error_cells_df: pd.DataFrame, target_columns: List[str]) \
+                         error_cells_df: pd.DataFrame, target_columns: List[str],
+                         integral_columns: Set[str]) \
             -> Tuple[pd.DataFrame, pd.DataFrame]:
         repaired_dfs = [self._empty_repaired_cells_frame()]
         if self._repair_by_regex_enabled:
@@ -444,7 +449,7 @@ class RepairModel:
             repaired_dfs.append(by_regex)
         if self._repair_by_nearest_values_enabled:
             error_cells_df, by_nv = self._repair_by_nearest_values(
-                repair_base_df, error_cells_df, target_columns)
+                repair_base_df, error_cells_df, target_columns, integral_columns)
             repaired_dfs.append(by_nv)
         repaired_by_rules = pd.concat(repaired_dfs, ignore_index=True)
         return error_cells_df, repaired_by_rules
@@ -465,6 +470,16 @@ class RepairModel:
             for corr, f in fts:
                 if len(top_k) <= 1 or (float(corr) >= 0.0 and len(top_k) < max_cols):
                     top_k.append((float(corr), f))
+            if not top_k:
+                # No rankable pairwise stats for y (candidate-pair pruning can
+                # drop every pair on small/low-correlation data) — selection
+                # cannot rank, so take the first max_cols features instead of
+                # training a featureless model; the user's column cap holds.
+                _logger.info(
+                    "[Repair Model Training Phase] no pairwise stats for {}; "
+                    "keeping the first {} of {} features".format(
+                        y, min(max_cols, len(features)), len(features)))
+                return features[:max_cols]
             _logger.info(
                 "[Repair Model Training Phase] {} features ({}) selected from {} "
                 "features".format(
@@ -922,8 +937,10 @@ class RepairModel:
 
         repaired_by_rules_df = None
         if self.repair_by_rules:
+            integral_columns = {
+                c.name for c in table.columns if c.kind == KIND_INTEGRAL}
             error_cells_df, repaired_by_rules_df = self._repair_by_rules(
-                repair_base_df, error_cells_df, target_columns)
+                repair_base_df, error_cells_df, target_columns, integral_columns)
             repair_base_df = repair_attrs_from(
                 repaired_by_rules_df, repair_base_df, self._row_id,
                 self._continuous_kind_map(table))
@@ -991,8 +1008,12 @@ class RepairModel:
             .rename(columns={"value": "repaired"})
         repair_candidates_df = repair_candidates_df[
             [self._row_id, "attribute", "current_value", "repaired"]]
+        # keep cells whose repair stayed NULL ("couldn't repair") — reference
+        # result shaping `repaired IS NULL OR NOT(current <=> repaired)`
+        # (model.py:1391-1408); pandas turns None into NaN on assignment, so
+        # test via _is_null rather than `is None`
         changed = [
-            (r is None) or not _null_safe_eq(c, r)
+            _is_null(r) or not _null_safe_eq(c, r)
             for c, r in zip(repair_candidates_df["current_value"],
                             repair_candidates_df["repaired"])]
         repair_candidates_df = repair_candidates_df[changed].reset_index(drop=True)
@@ -1084,9 +1105,13 @@ class RepairModel:
         return df
 
 
+def _is_null(v: Any) -> bool:
+    return v is None or (not isinstance(v, (list, dict)) and pd.isna(v))
+
+
 def _null_safe_eq(a: Any, b: Any) -> bool:
-    a_null = a is None or (not isinstance(a, (list, dict)) and pd.isna(a))
-    b_null = b is None or (not isinstance(b, (list, dict)) and pd.isna(b))
+    a_null = _is_null(a)
+    b_null = _is_null(b)
     if a_null or b_null:
         return a_null and b_null
     return str(a) == str(b)

@@ -18,6 +18,7 @@ import pandas as pd
 
 from delphi_tpu.session import AnalysisException
 from delphi_tpu.utils import setup_logger
+from delphi_tpu.utils.native import get_dict_encoder
 
 _logger = setup_logger()
 
@@ -95,7 +96,6 @@ class EncodedColumn:
 def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColumn:
     kind = column_kind(series)
     strings = _value_strings(series, kind)
-    from delphi_tpu.utils.native import get_dict_encoder
     encoder = get_dict_encoder()
     if encoder is not None:
         codes, uniques = encoder.encode(strings.tolist())

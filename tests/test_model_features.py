@@ -1,0 +1,410 @@
+"""Feature-parity tests mirroring the reference's test_model.py behaviors not
+covered by test_model.py here: input validation edge cases, typed inputs,
+escaped column names, rule-based repairs, PMF/score modes on mixed data,
+rebalancing, and repair-updates round-trips
+(reference python/repair/tests/test_model.py:330-1224)."""
+
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import delphi
+from delphi_tpu.costs import Levenshtein
+from delphi_tpu.errors import ConstraintErrorDetector, NullErrorDetector
+from delphi_tpu.session import AnalysisException
+
+from conftest import load_testdata
+
+
+@pytest.fixture
+def adult(session, adult_df):
+    session.register("adult", adult_df)
+    return adult_df
+
+
+@pytest.fixture
+def mixed_input(session):
+    # reference test_model.py:65-85
+    df = pd.DataFrame({
+        "tid": range(1, 18),
+        "v1": pd.array([0, 1, 0, 1, 1, 1, 0, 1, 0, None, 0, 0, 0, 0, 0, 0, 0],
+                       dtype="Int64"),
+        "v2": [1.0, 1.5, 1.4, 1.3, 1.2, 1.1, None, 1.4, 1.2, 1.3, 1.0, 1.9,
+               1.2, 1.8, 1.3, 1.3, 1.3],
+        "v3": [1.0, 1.5, None, 1.3, 1.1, 1.2, 1.4, 1.0, 1.1, 1.2, 1.9, 1.2,
+               1.3, 1.2, 1.1, 1.0, 1.0],
+        "v4": ["a", "b", "b", "b", "b", "b", "b", "b", "b", "b", "b", "b",
+               "b", None, "b", "b", "b"],
+    })
+    session.register("mixed_input", df)
+    return df
+
+
+def _build(input_name="adult"):
+    return delphi.repair.setInput(input_name).setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()])
+
+
+# -- input validation (reference test_model.py:767-812) ----------------------
+
+def test_rowid_uniqueness(session):
+    session.register("dup_input", pd.DataFrame(
+        {"tid": [1, 1, 1], "x": [1, 1, 2], "y": [None, "test-1", "test-1"]}))
+    with pytest.raises(AnalysisException, match="Uniqueness does not hold"):
+        _build("dup_input").run()
+
+
+def test_table_has_no_enough_columns(session):
+    session.register("narrow_input", pd.DataFrame(
+        {"tid": [1, 2, 3], "x": [None, "test-1", "test-1"]}))
+    with pytest.raises(AnalysisException, match="A least three columns"):
+        _build("narrow_input").run()
+
+
+def test_unsupported_types(session):
+    session.register("typed_input", pd.DataFrame({
+        "tid": [0], "x": [1],
+        "y": pd.to_datetime(["2021-08-01"])}))
+    with pytest.raises(AnalysisException, match="unsupported ones found"):
+        _build("typed_input").run()
+
+
+def test_maximal_likelihood_on_continuous_fails(mixed_input):
+    m = delphi.repair.setInput("mixed_input").setRowId("tid") \
+        .setRepairDelta(1).setUpdateCostFunction(Levenshtein())
+    with pytest.raises(ValueError, match="when continous attributes found"):
+        m.run(maximal_likelihood_repair=True)
+
+
+def test_invalid_running_modes_with_nearest_values(adult):
+    m = _build().setRepairByRules(True) \
+        .setUpdateCostFunction(Levenshtein()).setRepairDelta(3) \
+        .option("model.rule.repair_by_nearest_values.disabled", "")
+    for kwargs in ({"maximal_likelihood_repair": True},
+                   {"compute_repair_candidate_prob": True},
+                   {"compute_repair_prob": True},
+                   {"compute_repair_score": True}):
+        with pytest.raises(ValueError, match="nearest values"):
+            m.run(**kwargs)
+
+
+def test_accepted_option_keys(session):
+    # reference test_model.py:283-324 — every public option key validates
+    for key, value in [
+        ("error.domain_threshold_alpha", "0.0"),
+        ("error.domain_threshold_beta", "0.7"),
+        ("error.max_attrs_to_compute_pairwise_stats", "3"),
+        ("error.max_attrs_to_compute_domains", "2"),
+        ("error.attr_freq_ratio_threshold", "0.0"),
+        ("error.pairwise_freq_ratio_threshold", "0.05"),
+        ("model.max_training_row_num", "100000"),
+        ("model.max_training_column_num", "65536"),
+        ("model.small_domain_threshold", "12"),
+        ("model.rule.repair_by_nearest_values.disabled", "1"),
+        ("model.rule.merge_threshold", "2.0"),
+        ("model.rule.repair_by_regex.disabled", ""),
+        ("model.rule.repair_by_functional_deps.disabled", ""),
+        ("model.rule.max_domain_size", "1000"),
+        ("repair.pmf.cost_weight", "0.1"),
+        ("repair.pmf.prob_threshold", "0.0"),
+        ("repair.pmf.prob_top_k", "80"),
+        ("model.cv.n_splits", "3"),
+        ("model.hp.timeout", "0"),
+        ("model.hp.max_evals", "10000000"),
+        ("model.hp.no_progress_loss", "50"),
+    ]:
+        delphi.repair.option(key, value)
+
+
+def test_invalid_internal_option_value(adult):
+    m = _build().option("error.attr_freq_ratio_threshold", "invalid")
+    with pytest.raises(ValueError, match="error.attr_freq_ratio_threshold"):
+        m.run()
+
+
+# -- typed / quirky inputs ---------------------------------------------------
+
+def test_integer_input(session):
+    # reference test_model.py:1121-1145: all-integer input with NULLs; repairs
+    # come back as integer-formatted strings.
+    df = pd.DataFrame({
+        "tid": range(1, 10),
+        "v1": pd.array([1, 2, 3, 2, None, 2, 3, 2, 1], dtype="Int64"),
+        "v2": pd.array([1, None, 2, 2, 1, 2, 1, 1, 1], dtype="Int64"),
+        "v3": pd.array([3, 2, 2, 3, 3, 3, None, 2, 2], dtype="Int64"),
+        "v4": pd.array([0, 1, 0, 1, 0, 0, 0, 1, None], dtype="Int64"),
+    })
+    session.register("int_input", df)
+    out = _build("int_input").run()
+    got = sorted(zip(out["tid"], out["attribute"]))
+    assert got == [(2, "v2"), (5, "v1"), (7, "v3"), (9, "v4")]
+    for v in out["repaired"]:
+        assert v is not None
+        float(v)  # integer-formatted strings
+
+
+def test_escaped_column_names(session):
+    # reference test_model.py:687-746: column names with spaces flow through
+    # every mode unquoted.
+    df = pd.DataFrame({
+        "t i d": [1, 2, 3, 4, 5, 6],
+        "x x": ["1", None, "1", "2", "2", "1"],
+        "y y": [None, "test-2", "test-1", "test-2", "test-2", "test-1"],
+        "z z": [1.0, 2.0, 1.0, 2.0, 1.0, 1.0],
+    })
+    session.register("escaped_input", df)
+
+    def build():
+        return delphi.repair.setInput("escaped_input").setRowId("t i d") \
+            .setErrorDetectors([NullErrorDetector()]).setDiscreteThreshold(10)
+
+    out = build().run()
+    got = sorted(zip(out["t i d"], out["attribute"]))
+    assert got == [(1, "y y"), (2, "x x")]
+
+    out = build().run(compute_repair_prob=True)
+    assert sorted(zip(out["t i d"], out["attribute"])) == [(1, "y y"), (2, "x x")]
+
+    out = build().run(repair_data=True)
+    assert len(out) == 6
+    assert out[[c for c in out.columns if c != "t i d"]].notna().all().all()
+
+
+def test_error_cells_having_no_existent_attribute(adult, session):
+    # reference test_model.py:508-527: unknown attrs in the error-cell table
+    # are silently dropped.
+    session.register("err_cells", pd.DataFrame({
+        "tid": [1, 5, 16], "attribute": ["NoExistent", "Income", "Income"]}))
+    out = _build().setErrorCells("err_cells").run()
+    assert sorted(zip(out["tid"], out["attribute"])) == \
+        [(5, "Income"), (16, "Income")]
+    assert out["repaired"].notna().all()
+
+
+def test_setinput_dataframe(session, adult_df):
+    out = delphi.repair.setInput(adult_df).setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]).run(detect_errors_only=True)
+    assert len(out) == 7
+
+
+def test_input_overwrite(session, adult_df):
+    # reference test_model.py:392-404: a later setInput(DataFrame) overrides
+    # an earlier setTableName.
+    session.register("adult_other", adult_df.head(0))
+    out = delphi.repair.setTableName("adult_other").setInput(adult_df) \
+        .setRowId("tid").setErrorDetectors([NullErrorDetector()]) \
+        .run(detect_errors_only=True)
+    assert len(out) == 7
+
+
+def test_multiple_run(adult, session):
+    # reference test_model.py:328-367: same result on repeated runs and no
+    # leaked registry entries.
+    names_before = set(session.table_names())
+    m = _build()
+    r1 = m.run().sort_values(["tid", "attribute"]).reset_index(drop=True)
+    r2 = m.run().sort_values(["tid", "attribute"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(r1[["tid", "attribute"]], r2[["tid", "attribute"]])
+    assert set(session.table_names()) == names_before
+
+
+# -- degenerate-feature failure modes (test_model.py:813-866) ----------------
+
+def test_no_valid_discrete_feature_exists(session):
+    session.register("degenerate1", pd.DataFrame({
+        "tid": [1, 2, 3, 4, 5, 6],
+        "x": ["1", "1", "1", "1", "1", "1"],  # single-valued -> dropped
+        "y": [None, None, "test-1", "test-1", "test-1", None],
+    }))
+    m = _build("degenerate1")
+    with pytest.raises(ValueError, match="At least one valid discretizable feature"):
+        m.run()
+
+
+def test_no_valid_discrete_feature_exists_high_cardinality(session):
+    session.register("degenerate2", pd.DataFrame({
+        "tid": [1, 2, 3, 4, 5, 6],
+        "x": ["1", "2", "3", "4", "5", "6"],  # domain > threshold -> dropped
+        "y": [None, "test-2", "test-3", "test-4", "test-5", "test-6"],
+    }))
+    m = _build("degenerate2").setDiscreteThreshold(3)
+    with pytest.raises(ValueError, match="At least one valid discretizable feature"):
+        m.run()
+    out = m.run(detect_errors_only=True)
+    assert sorted(zip(out["tid"], out["attribute"])) == [(1, "y")]
+
+
+# -- model behaviors ---------------------------------------------------------
+
+def test_regressor_model(session):
+    # reference test_model.py:866-891: continuous target learns from
+    # correlated continuous features.
+    session.register("reg_input", pd.DataFrame({
+        "tid": [1, 2, 3, 4, 5, 6],
+        "x": [1.0, 1.5, 1.4, 1.3, 1.1, 1.2],
+        "y": [1.0, 1.5, 1.4, 1.3, 1.1, 1.2],
+        "z": [1.0, 1.5, None, 1.3, 1.1, None],
+    }))
+    out = _build("reg_input").run()
+    got = sorted(zip(out["tid"], out["attribute"]))
+    assert got == [(3, "z"), (6, "z")]
+    assert out["repaired"].notna().all()
+    for v in out["repaired"]:
+        assert 0.5 <= float(v) <= 2.0
+
+
+def test_max_training_column_num(adult):
+    out = _build().setDiscreteThreshold(5) \
+        .option("model.max_training_column_num", "2").run()
+    assert len(out) == 7
+    assert out["repaired"].notna().all()
+
+
+def test_timeout_option(adult):
+    out = _build().option("model.hp.timeout", "3").run()
+    assert len(out) == 7
+
+
+def test_training_data_rebalancing(mixed_input):
+    out = delphi.repair.setInput("mixed_input").setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .setTrainingDataRebalancingEnabled(True).run()
+    got = sorted(zip(out["tid"], out["attribute"]))
+    assert got == [(3, "v3"), (7, "v2"), (10, "v1"), (14, "v4")]
+    assert out["repaired"].notna().all()
+
+
+def test_parallel_stat_training_equivalence(adult):
+    base = _build().run().sort_values(["tid", "attribute"]).reset_index(drop=True)
+    par = _build().setParallelStatTrainingEnabled(True).run() \
+        .sort_values(["tid", "attribute"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(base[["tid", "attribute"]], par[["tid", "attribute"]])
+
+
+# -- PMF / score modes on mixed data (test_model.py:1008-1120) ---------------
+
+def test_compute_repair_prob_for_continuous_values(mixed_input):
+    def run_modes(m):
+        pmf_df = m.run(compute_repair_candidate_prob=True)
+        assert sorted(pmf_df.columns) == ["attribute", "current_value", "pmf", "tid"]
+        got = sorted(zip(pmf_df["tid"], pmf_df["attribute"]))
+        assert got == [(3, "v3"), (7, "v2"), (10, "v1"), (14, "v4")]
+
+        prob_df = m.run(compute_repair_prob=True)
+        assert sorted(prob_df.columns) == \
+            ["attribute", "current_value", "prob", "repaired", "tid"]
+        assert ((prob_df["prob"] > 0) & (prob_df["prob"] <= 1.0)).all()
+
+    m = delphi.repair.setInput("mixed_input").setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()])
+    run_modes(m)
+    run_modes(m.setUpdateCostFunction(Levenshtein()))
+
+
+def test_compute_repair_score_schema(adult):
+    out = _build().setUpdateCostFunction(Levenshtein()).setRepairDelta(1) \
+        .run(compute_repair_score=True)
+    assert sorted(out.columns) == \
+        ["attribute", "current_value", "repaired", "score", "tid"]
+    assert len(out) == 7
+    assert np.isfinite(out["score"].astype(float)).all()
+
+
+def test_compute_weighted_probs_for_target_attributes(adult, session):
+    # reference test_model.py:1022-1059: a huge Levenshtein cost weight on one
+    # attribute pushes its top-candidate prob to ~1 and leaves others alone.
+    constraint_path = "/root/reference/testdata/adult_constraints.txt"
+    m = delphi.repair.setInput("adult").setRowId("tid") \
+        .setTargets(["Sex", "Relationship"]) \
+        .setErrorDetectors([ConstraintErrorDetector(constraint_path)])
+    base = m.run(compute_repair_candidate_prob=True)
+    weighted = m.setUpdateCostFunction(Levenshtein(targets=["Sex"])) \
+        .option("repair.pmf.cost_weight", "100000000.0") \
+        .run(compute_repair_candidate_prob=True)
+
+    base_top = {(t, a): pmf[0]["prob"]
+                for t, a, pmf in zip(base["tid"], base["attribute"], base["pmf"])}
+    weighted_top = {(t, a): pmf[0]["prob"]
+                    for t, a, pmf in
+                    zip(weighted["tid"], weighted["attribute"], weighted["pmf"])}
+    assert base_top.keys() == weighted_top.keys()
+    sex_keys = [k for k in base_top if k[1] == "Sex"]
+    assert sex_keys
+    for k in sex_keys:
+        assert weighted_top[k] > 0.9999
+        assert weighted_top[k] >= base_top[k]
+
+
+# -- rule-based repairs (test_model.py:892-1007) -----------------------------
+
+def test_repair_by_functional_deps(session):
+    session.register("fd_input", pd.DataFrame({
+        "tid": [1, 2, 3, 4, 5, 6],
+        "x": ["1", "2", "1", "2", "2", "3"],
+        "y": ["test-1", "test-2", None, "test-2", None, None],
+    }))
+    session.register("fd_cells", pd.DataFrame({
+        "tid": [3, 5, 6], "attribute": ["y", "y", "y"]}))
+
+    with tempfile.NamedTemporaryFile("w+t", suffix=".txt") as f:
+        f.write("t1&t2&EQ(t1.x,t2.x)&IQ(t1.y,t2.y)")
+        f.flush()
+        out = delphi.repair.setInput("fd_input").setRowId("tid") \
+            .setErrorCells("fd_cells") \
+            .setErrorDetectors([NullErrorDetector(), ConstraintErrorDetector(f.name)]) \
+            .setRepairByRules(True) \
+            .option("model.rule.max_domain_size", "1000") \
+            .run()
+    got = {(t, a): r for t, a, r in zip(out["tid"], out["attribute"], out["repaired"])}
+    assert got[(3, "y")] == "test-1"
+    assert got[(5, "y")] == "test-2"
+    # x=3 appears once: no FD evidence -> left unrepaired (NULL)
+    assert (6, "y") in got and (got[(6, "y")] is None or pd.isna(got[(6, "y")]))
+
+
+def test_repair_by_nearest_values(session):
+    # reference test_model.py:930-987 (exact expected repairs)
+    session.register("nv_input", pd.DataFrame({
+        "tid": [1, 3, 4, 5, 6],
+        "v0": ["100%", "32%", "1xx%", "100x", "12x"],
+        "v1": pd.array([100, 101, 1, 2, 300], dtype="Int64"),
+        "v2": ["a", "b", "a", "b", "a"],
+        "v3": [1.0, 1.1, 1.3, 0.6, 0.8],
+    }))
+    session.register("nv_cells", pd.DataFrame({
+        "tid": [4, 5, 6, 3, 5, 6, 5],
+        "attribute": ["v0", "v0", "v0", "v1", "v1", "v1", "v2"]}))
+
+    out = delphi.repair.setInput("nv_input").setRowId("tid") \
+        .setErrorCells("nv_cells").setRepairByRules(True) \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .setUpdateCostFunction(Levenshtein(targets=["v0", "v1"])) \
+        .option("model.rule.repair_by_nearest_values.disabled", "") \
+        .option("model.rule.merge_threshold", "2.0") \
+        .run()
+    got = {(t, a): r for t, a, r in zip(out["tid"], out["attribute"], out["repaired"])}
+    assert got[(3, "v1")] == "100"
+    assert got[(4, "v0")] == "100%"
+    assert got[(5, "v0")] == "100%"
+    assert got[(5, "v1")] == "1"
+    assert got[(6, "v0")] == "32%"
+    assert got[(6, "v1")] == "100"
+
+
+def test_repair_updates_roundtrip(adult, session):
+    # reference test_model.py:988-1007: applying run()'s updates via misc
+    # reproduces adult_clean.
+    clean = load_testdata("adult_clean.csv")
+    updates = _build().run()
+    session.register("repair_updates_v", updates)
+    fixed = delphi.misc.options({
+        "repair_updates": "repair_updates_v",
+        "table_name": "adult",
+        "row_id": "tid"}).repair()
+    merged = fixed.sort_values("tid").reset_index(drop=True)
+    clean = clean.sort_values("tid").reset_index(drop=True)
+    assert merged[[c for c in merged.columns if c != "tid"]].notna().all().all()
+    # Sex cells with Husband/Wife relationship are deterministic
+    assert (merged["Sex"] == clean["Sex"]).all()

@@ -66,7 +66,7 @@ def test_encode_column_native_equals_pandas(monkeypatch):
 
     s = pd.Series(["x", None, "y", "x", "z", "y"], name="attr")
     with_native = table_mod.encode_column(s)
-    monkeypatch.setattr(table_mod, "_native_dict_encoder", lambda: None)
+    monkeypatch.setattr(table_mod, "get_dict_encoder", lambda: None)
     without = table_mod.encode_column(s)
     assert with_native.codes.tolist() == without.codes.tolist()
     assert list(with_native.vocab) == list(without.vocab)
@@ -82,7 +82,7 @@ def test_qgram_native_equals_python(monkeypatch):
         "b": [f"x{rng.integers(50)}" for _ in range(300)],
     })
     nat = cl.qgram_features(df, 3)
-    monkeypatch.setattr(cl, "_native_qgram", lambda: None)
+    monkeypatch.setattr(cl, "get_qgram", lambda: None)
     py = cl.qgram_features(df, 3)
     assert (nat == py).all()
     assert nat.sum() > 0
