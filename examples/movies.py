@@ -1,0 +1,54 @@
+"""Raha movies repair with ground-truth error cells
+(reference resources/examples/movies.py): another known-failure dataset —
+the reference transcript records P/R/F1 = 0.0 (long free-text attributes).
+Uses discreteThreshold=600 and the reference's relaxed search budget.
+
+    python examples/movies.py [path-to-raha-testdata]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pandas as pd
+
+from delphi_tpu import delphi
+
+TESTDATA = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/testdata/raha"
+
+if not os.path.exists(f"{TESTDATA}/movies.csv"):
+    print(f"SKIP: {TESTDATA}/movies.csv not found (the raha movies dataset "
+          "is not bundled in this checkout; pass its directory as argv[1])")
+    sys.exit(0)
+
+movies = pd.read_csv(f"{TESTDATA}/movies.csv", dtype=str, escapechar="\\")
+clean = pd.read_csv(f"{TESTDATA}/movies_clean.csv", dtype=str, escapechar="\\")
+delphi.register_table("movies", movies)
+
+flat = delphi.misc.options({"table_name": "movies", "row_id": "id"}).flatten()
+merged = flat.merge(clean, on=["id", "attribute"], how="inner")
+neq = ~((merged["value"] == merged["correct_val"])
+        | (merged["value"].isna() & merged["correct_val"].isna()))
+delphi.register_table(
+    "error_cells_ground_truth",
+    merged[neq][["id", "attribute"]].reset_index(drop=True))
+
+repaired_df = delphi.repair \
+    .setDbName("default") \
+    .setTableName("movies") \
+    .setRowId("id") \
+    .setErrorCells("error_cells_ground_truth") \
+    .setDiscreteThreshold(600) \
+    .run()
+
+pdf = repaired_df.merge(clean, on=["id", "attribute"], how="inner")
+rdf = delphi.table("error_cells_ground_truth") \
+    .merge(repaired_df, on=["id", "attribute"], how="left") \
+    .merge(clean, on=["id", "attribute"], how="left")
+
+nse = lambda a, b: (a == b) | (a.isna() & b.isna())
+precision = float(nse(pdf["repaired"], pdf["correct_val"]).mean()) if len(pdf) else 0.0
+recall = float(nse(rdf["repaired"], rdf["correct_val"]).mean())
+f1 = (2.0 * precision * recall) / (precision + recall + 0.0001)
+print(f"Precision={precision} Recall={recall} F1={f1}")
