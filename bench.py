@@ -12,6 +12,9 @@ vs_baseline = reference_seconds / ours (speedup, higher is better).
 
 Usage: python bench.py [--scale N]   (replicates rows N times for scale-out
 measurements; quality is only scored at scale 1)
+       python bench.py --workload hospital-scale [--scale N]
+           (BASELINE.json north-star config: hospital rows replicated N
+            times, NULL-injected, detect+repair, reports cells-repaired/sec)
 """
 
 import argparse
@@ -23,10 +26,65 @@ REFERENCE_SECONDS = 247.69667196273804  # flights.py.out, laptop-class CPU
 TESTDATA = "/root/reference/testdata/raha"
 
 
+def hospital_scale(scale: int) -> None:
+    """North-star scale-out workload (BASELINE.json configs[4]): hospital
+    rows replicated `scale` times, 3% of cells in three attrs nulled, full
+    detect -> train -> repair; reports cells-repaired/sec."""
+    import pandas as pd
+
+    import jax
+
+    from delphi_tpu import NullErrorDetector, delphi
+
+    device = str(jax.devices()[0])
+    hospital = pd.read_csv("/root/reference/testdata/hospital.csv", dtype=str)
+    parts = []
+    for i in range(scale):
+        part = hospital.copy()
+        part["tid"] = (part.index + i * len(hospital)).astype(str)
+        parts.append(part)
+    big = pd.concat(parts, ignore_index=True)
+    delphi.register_table("hospital_big", big)
+
+    injected = delphi.misc.options({
+        "table_name": "hospital_big", "row_id": "tid",
+        "target_attr_list": "ZipCode,City,State", "null_ratio": "0.03",
+        "seed": "0"}).injectNull()
+    delphi.register_table("hospital_dirty", injected)
+
+    jax.block_until_ready(jax.numpy.zeros(8).sum())
+    t0 = time.time()
+    repaired = delphi.repair \
+        .setTableName("hospital_dirty") \
+        .setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .run()
+    elapsed = time.time() - t0
+
+    cells_per_sec = len(repaired) / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": "hospital_scale_cells_repaired_per_sec",
+        "value": round(cells_per_sec, 1),
+        "unit": "cells/s",
+        "vs_baseline": None,
+        "scale": scale,
+        "rows": int(len(big)),
+        "repairs": int(len(repaired)),
+        "elapsed_s": round(elapsed, 3),
+        "device": device,
+    }))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--scale", type=int, default=1)
+    parser.add_argument("--workload", choices=["flights", "hospital-scale"],
+                        default="flights")
     args = parser.parse_args()
+
+    if args.workload == "hospital-scale":
+        hospital_scale(args.scale)
+        return
 
     import numpy as np
     import pandas as pd
@@ -87,6 +145,7 @@ def main() -> None:
         "scale": args.scale,
         "rows": int(len(flights)),
         "repairs": int(len(repaired)),
+        "cells_per_sec": round(len(repaired) / elapsed, 1) if elapsed else 0.0,
         "device": device,
     }
 

@@ -1,0 +1,46 @@
+"""REPL startup: preloads the Delphi API (reference `bin/.startup.py`).
+
+Configures INFO logging for pipeline narration, imports the `delphi`
+singleton plus the error detectors / cost functions, and — when
+``DELPHI_TESTDATA`` points at a directory — registers every ``*.csv`` in it
+as a catalog table so `delphi.repair.setTableName("adult")...` works out of
+the box (the analog of the reference's Hive-backed testdata tables).
+"""
+
+import logging
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+warnings.simplefilter("ignore")
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s.%(msecs)03d %(levelname)s %(module)s: %(message)s",
+    datefmt="%Y-%m-%d %H:%M:%S",
+)
+
+from delphi_tpu import delphi  # noqa: E402,F401
+from delphi_tpu import (  # noqa: E402,F401
+    ConstraintErrorDetector, DomainValues, GaussianOutlierErrorDetector,
+    LOFOutlierErrorDetector, Levenshtein, NullErrorDetector,
+    RegExErrorDetector, ScikitLearnBackedErrorDetector,
+    UserDefinedUpdateCostFunction)
+
+_testdata = os.environ.get("DELPHI_TESTDATA", "")
+if _testdata and os.path.isdir(_testdata):
+    import pandas as pd
+    for _f in sorted(os.listdir(_testdata)):
+        if _f.endswith(".csv"):
+            _name = _f[:-4]
+            try:
+                delphi.register_table(
+                    _name, pd.read_csv(os.path.join(_testdata, _f), dtype=str))
+            except Exception as e:  # malformed fixture should not kill the REPL
+                print(f"skipped {_f}: {e}")
+    from delphi_tpu.session import get_session
+    print(f"Registered testdata tables from {_testdata}: "
+          f"{', '.join(get_session().table_names())}")
+
+print(f"Delphi APIs (version {delphi.version()}) available as 'delphi'.")

@@ -55,5 +55,17 @@ class Delphi:
         return get_session().table(name)
 
     @staticmethod
+    def setConf(key: str, value: str) -> None:
+        """Sets a framework config key — the analog of the reference's JVM
+        ConfigEntry tier (`RepairConf.scala:45-54`). Recognized keys:
+        ``repair.logLevel`` (routes pipeline narration, default TRACE) and
+        ``repair.profile.dir`` (enables XLA profiler traces around runs)."""
+        get_session().conf[key] = value
+
+    @staticmethod
+    def getConf(key: str, default: str = "") -> str:
+        return get_session().conf.get(key, default)
+
+    @staticmethod
     def version() -> str:
         return "0.1.0-tpu-EXPERIMENTAL"

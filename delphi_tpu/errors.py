@@ -29,7 +29,8 @@ from delphi_tpu.ops.entropy import compute_pairwise_stats, select_candidate_pair
 from delphi_tpu.ops.freq import FreqStats, PairDistinctCounter, compute_freq_stats
 from delphi_tpu.session import get_session
 from delphi_tpu.table import DiscretizedTable, EncodedTable, discretize_table
-from delphi_tpu.utils import get_option_value, job_phase, setup_logger, to_list_str
+from delphi_tpu.utils import (
+    get_option_value, job_phase, log_based_on_level, setup_logger, to_list_str)
 
 _logger = setup_logger()
 
@@ -463,6 +464,15 @@ class ErrorModel:
             disc.table.n_rows, freq, candidate_pairs, domain_stats)
         for t in target_columns:
             pairwise.setdefault(t, [])
+        # Engine-internal detail routed by the `repair.logLevel` config key —
+        # the analog of the reference's `logBasedOnLevel` narration of its
+        # generated stats SQL (RepairApi.scala:301, LoggingBasedOnLevel.scala).
+        log_based_on_level(
+            lambda: f"candidate pairs for pairwise stats: {candidate_pairs}")
+        log_based_on_level(
+            lambda: "pairwise conditional-entropy stats: "
+            + "; ".join(f"{y}<-{[(x, round(h, 4)) for x, h in deps]}"
+                        for y, deps in pairwise.items()))
         return freq, pairwise
 
     @job_phase(name="cell domain analysis")

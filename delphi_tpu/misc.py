@@ -164,7 +164,11 @@ class RepairMisc:
         if unknown:
             raise AnalysisException(
                 f"Columns '{', '.join(unknown)}' do not exist in '{self.opts['table_name']}'")
-        rng = np.random.RandomState()
+        seed = self.opts.get("seed")
+        if seed is not None and not str(seed).isdigit():
+            raise ValueError(
+                f"Option 'seed' must be a non-negative integer, but '{seed}' found")
+        rng = np.random.RandomState(int(seed) if seed is not None else None)
         for attr in targets:
             mask = rng.rand(len(df)) <= ratio
             col = df[attr]

@@ -14,7 +14,10 @@ DataFrames in and out are pandas.
 """
 
 import copy
+import hashlib
 import heapq
+import os
+import pickle
 from collections import namedtuple
 from typing import Any, Dict, List, Optional, Set, Tuple, Union
 
@@ -33,8 +36,8 @@ from delphi_tpu.table import (
 from delphi_tpu.train import (
     build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
 from delphi_tpu.utils import (
-    argtype_check, elapsed_time, get_option_value, job_phase, setup_logger,
-    to_list_str)
+    argtype_check, elapsed_time, get_option_value, job_phase, log_based_on_level,
+    profile_trace, setup_logger, to_list_str)
 
 _logger = setup_logger()
 
@@ -156,6 +159,8 @@ class RepairModel:
     _opt_prob_top_k = \
         _option("repair.pmf.prob_top_k", 32, int,
                 lambda v: v >= 3, "`{}` should be greater than 2")
+    _opt_checkpoint_path = \
+        _option("model.checkpoint_path", "", str, None, None)
 
     option_keys = set([
         _opt_max_training_row_num.key,
@@ -169,6 +174,7 @@ class RepairModel:
         _opt_cost_weight.key,
         _opt_prob_threshold.key,
         _opt_prob_top_k.key,
+        _opt_checkpoint_path.key,
         *ErrorModel.option_keys,
         *train_option_keys])
 
@@ -459,6 +465,11 @@ class RepairModel:
     def _select_features(self, pairwise_attr_stats: Dict[str, Any], y: str,
                          features: List[str]) -> List[str]:
         """Correlation-ranked feature pruning (reference model.py:677-699)."""
+        # Engine-internal detail routed by `repair.logLevel` (hidden at the
+        # default TRACE level, like the reference's logBasedOnLevel).
+        log_based_on_level(
+            lambda: f"selecting features for y={y} from candidates {features} "
+            f"using pairwise stats {pairwise_attr_stats.get(y)}")
         max_cols = int(self._get_option_value(*self._opt_max_training_column_num))
         if max_cols < len(features) and y in pairwise_attr_stats:
             heap: List[Tuple[float, str]] = []
@@ -897,6 +908,78 @@ class RepairModel:
 
     # -- run ------------------------------------------------------------------
 
+    # -- checkpoint/resume ----------------------------------------------------
+    #
+    # The reference never persists trained models (SURVEY.md §5: pickling is
+    # transport-only, model.py:910/921, with an acknowledged checkpoint TODO at
+    # model.py:1094). Here `option("model.checkpoint_path", dir)` saves the
+    # trained per-attribute models after phase 2 and reuses them on the next
+    # run when the target-column set matches, so repeated repairs of a table
+    # (or a re-run after an inference-phase failure) skip training entirely.
+
+    def _checkpoint_file(self) -> str:
+        path = self._get_option_value(*self._opt_checkpoint_path)
+        return os.path.join(path, "repair_models.pkl") if path else ""
+
+    def _checkpoint_fingerprint(self, train_df: pd.DataFrame,
+                                target_columns: List[str]) -> Dict[str, Any]:
+        """Identity of a trained-model set: the input table name, its shape
+        and schema, a cheap content hash, and every model.* option. A
+        checkpoint is only reused when all of these match, so a different
+        table (or the same table with edited rows/options) retrains."""
+        content = hashlib.sha1(
+            pd.util.hash_pandas_object(
+                train_df.astype(str), index=False).values.tobytes()).hexdigest()
+        return {
+            "version": 2,
+            "input": self._session.qualified_name(
+                self.db_name,
+                self.input if isinstance(self.input, str) else "<dataframe>"),
+            "targets": sorted(target_columns),
+            "columns": list(train_df.columns),
+            "n_rows": int(len(train_df)),
+            "content_sha1": content,
+            # Every expert option is part of the identity: error.* knobs shape
+            # the stats that feed feature selection, model.* shape training.
+            # (repair.pmf.* retrains unnecessarily but never reuses stale.)
+            "opts": dict(sorted(self.opts.items())),
+        }
+
+    def _load_model_checkpoint(self, fingerprint: Dict[str, Any]) -> Optional[List[Any]]:
+        ckpt = self._checkpoint_file()
+        if not ckpt or not os.path.exists(ckpt):
+            return None
+        try:
+            with open(ckpt, "rb") as f:
+                payload = pickle.load(f)
+        except Exception as e:
+            _logger.warning(f"Ignoring unreadable model checkpoint {ckpt}: {e}")
+            return None
+        if not isinstance(payload, dict) or "models" not in payload:
+            _logger.warning(
+                f"Ignoring model checkpoint {ckpt}: unrecognized format")
+            return None
+        if payload.get("fingerprint") != fingerprint:
+            _logger.warning(
+                f"Ignoring stale model checkpoint {ckpt}: "
+                "input/targets/options changed since it was written")
+            return None
+        _logger.info(f"Loaded {len(payload['models'])} repair models from {ckpt}")
+        return payload["models"]
+
+    def _save_model_checkpoint(self, models: List[Any],
+                               fingerprint: Dict[str, Any]) -> None:
+        ckpt = self._checkpoint_file()
+        if not ckpt:
+            return
+        try:
+            os.makedirs(os.path.dirname(ckpt), exist_ok=True)
+            with open(ckpt, "wb") as f:
+                pickle.dump({"fingerprint": fingerprint, "models": models}, f)
+            _logger.info(f"Saved {len(models)} repair models to {ckpt}")
+        except Exception as e:
+            _logger.warning(f"Failed to write model checkpoint {ckpt}: {e}")
+
     @elapsed_time  # type: ignore
     def _run(self, table: EncodedTable, input_name: str,
              continuous_columns: List[str], detect_errors_only: bool,
@@ -950,9 +1033,15 @@ class RepairModel:
         clean_rows_df = repair_base_df[~is_dirty]
         dirty_rows_df = repair_base_df[is_dirty]
 
-        models = self._build_repair_models(
-            repair_base_df, target_columns, continuous_columns,
-            domain_stats, pairwise_attr_stats)
+        fingerprint = self._checkpoint_fingerprint(repair_base_df, target_columns) \
+            if self._checkpoint_file() else {}
+        models = self._load_model_checkpoint(fingerprint) if fingerprint else None
+        if models is None:
+            models = self._build_repair_models(
+                repair_base_df, target_columns, continuous_columns,
+                domain_stats, pairwise_attr_stats)
+            if fingerprint:
+                self._save_model_checkpoint(models, fingerprint)
 
         #######################################################################
         # 3. Repair Phase
@@ -1097,10 +1186,11 @@ class RepairModel:
                 f"Target attributes not found in {input_name}: "
                 f"{to_list_str(self.targets)}")
 
-        df, elapsed = self._run(
-            table, input_name, continuous_columns, detect_errors_only,
-            compute_repair_candidate_prob, compute_repair_prob,
-            compute_repair_score, repair_data, maximal_likelihood_repair)
+        with profile_trace("delphi.repair.run"):
+            df, elapsed = self._run(
+                table, input_name, continuous_columns, detect_errors_only,
+                compute_repair_candidate_prob, compute_repair_prob,
+                compute_repair_score, repair_data, maximal_likelihood_repair)
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
         return df
 

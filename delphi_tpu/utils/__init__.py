@@ -151,28 +151,101 @@ def elapsed_time(f):  # type: ignore
     return wrapper
 
 
+def log_based_on_level(msg: Any) -> None:
+    """Routes a message at the level named by the ``repair.logLevel`` session
+    config key — the framework-config analog of the reference's JVM
+    ``spark.repair.logLevel`` ConfigEntry (`RepairConf.scala:45-54`,
+    `LoggingBasedOnLevel.scala:26-37`). Unknown levels fall back to TRACE
+    semantics (DEBUG here), matching the reference's default.
+
+    ``msg`` may be a zero-arg callable, which is only invoked when the
+    resolved level is actually enabled — use this for expensive debug strings
+    so suppressed narration costs nothing."""
+    from delphi_tpu.session import get_session
+
+    level_name = get_session().conf.get("repair.logLevel", "TRACE").upper()
+    level = {"ERROR": logging.ERROR, "WARN": logging.WARNING,
+             "INFO": logging.INFO, "DEBUG": logging.DEBUG,
+             "TRACE": logging.DEBUG}.get(level_name, logging.DEBUG)
+    if not _logger.isEnabledFor(level):
+        return
+    _logger.log(level, msg() if callable(msg) else msg)
+
+
 class phase_span:
     """Phase-scoped timing span: the TPU-native analog of the reference's
     `@spark_job_group` (`utils.py:130-146`) + Spark job descriptions.
 
     Logs phase wall time; nesting is allowed. Also usable as a decorator via
-    :func:`job_phase`.
-    """
+    :func:`job_phase`. Each span additionally opens a
+    ``jax.profiler.TraceAnnotation`` so phases show up as named ranges in
+    XLA profiler traces captured via :func:`profile_trace` (the TPU-native
+    replacement for phases being visible in the Spark UI)."""
 
     _active: List[str] = []
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._t0 = 0.0
+        self._annotation: Any = None
 
     def __enter__(self) -> "phase_span":
         phase_span._active.append(self.name)
+        try:
+            import jax.profiler
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:
+            self._annotation = None
         self._t0 = time.time()
         return self
 
     def __exit__(self, *exc: Any) -> None:
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
         phase_span._active.pop()
         _logger.info(f"Elapsed time (name: {self.name}) is {time.time() - self._t0}(s)")
+
+
+class profile_trace:
+    """Captures an XLA/TPU profiler trace around a code block when enabled.
+
+    Enabled by the ``repair.profile.dir`` session config key or the
+    ``DELPHI_PROFILE_DIR`` env var; a no-op otherwise, so the pipeline can
+    wrap its phases unconditionally. Traces are written in TensorBoard
+    format; `phase_span` annotations appear as named ranges inside them.
+    The reference has no profiler (SURVEY.md §5) — this is the TPU-native
+    upgrade over its Spark-UI-only job groups."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._dir: Optional[str] = None
+
+    def __enter__(self) -> "profile_trace":
+        from delphi_tpu.session import get_session
+
+        self._dir = os.environ.get("DELPHI_PROFILE_DIR") \
+            or get_session().conf.get("repair.profile.dir") or None
+        if self._dir:
+            try:
+                import jax.profiler
+                jax.profiler.start_trace(self._dir)
+            except Exception as e:
+                _logger.warning(f"profiler unavailable: {e}")
+                self._dir = None
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._dir:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+                _logger.info(
+                    f"Profiler trace (name: {self.name}) written to {self._dir}")
+            except Exception as e:
+                # Never let a trace-flush failure fail (or mask an exception
+                # from) the profiled run itself.
+                _logger.warning(f"Failed to stop profiler trace: {e}")
 
 
 def job_phase(name: str):  # type: ignore

@@ -1,0 +1,28 @@
+# Sphinx configuration for delphi_tpu API docs (parity with the reference's
+# python/docs/source/conf.py; build with `make -C docs html` when sphinx is
+# installed).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "delphi_tpu"
+copyright = "2026, delphi_tpu developers"
+author = "delphi_tpu developers"
+release = "0.1.0-tpu-EXPERIMENTAL"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = "alabaster"
+html_static_path = ["_static"]
+
+autodoc_member_order = "bysource"
+autodoc_typehints = "description"
