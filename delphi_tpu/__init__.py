@@ -16,6 +16,35 @@ Public surface mirrors the reference:
         .run()
 """
 
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: the training/stats kernels take tens of
+# seconds to compile on TPU and the pipeline is typically re-run many times
+# over similar shapes; caching compiled executables across processes removes
+# that cost from every run after the first. Opt out with DELPHI_XLA_CACHE=0.
+if _os.environ.get("DELPHI_XLA_CACHE", "1") != "0":
+    try:
+        import hashlib as _hashlib
+
+        # Scope the cache by the XLA configuration: entries AOT-compiled
+        # under different XLA_FLAGS (e.g. the 8-virtual-device test config)
+        # are not safely loadable in other configs.
+        _fingerprint = _hashlib.sha1(
+            (_os.environ.get("XLA_FLAGS", "") + "|"
+             + _os.environ.get("JAX_PLATFORMS", "")).encode()).hexdigest()[:12]
+        _cache_dir = _os.environ.get(
+            "DELPHI_XLA_CACHE_DIR",
+            _os.path.join(_os.path.expanduser("~"), ".cache",
+                          f"delphi_tpu_xla_{_fingerprint}"))
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
 from delphi_tpu.api import Delphi
 from delphi_tpu.costs import Levenshtein, UpdateCostFunction, UserDefinedUpdateCostFunction
 from delphi_tpu.errors import (

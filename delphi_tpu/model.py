@@ -656,13 +656,27 @@ class RepairModel:
                 fx = [x for x in functional_deps[y]
                       if int(domain_stats[x]) < max_domain]
                 if len(fx) > 0:
-                    _logger.info(
-                        "Building {}/{} model... type=rule(FD: X->y)  y={}(|y|={}) "
-                        "X={}(|X|={})".format(
-                            index, len(target_columns), y, num_class_map[y],
-                            fx[0], domain_stats[fx[0]]))
                     fd_map = compute_functional_dep_map(train_df, fx[0], y)
-                    models[y] = (FunctionalDepModel(fx[0], fd_map), [fx[0]], None)
+                    # Coverage guard (improvement over the reference, whose
+                    # FunctionalDepModel returns None — an unrepairable cell —
+                    # for every x value absent from the map, model.py:86-87):
+                    # when masking left too many x groups without a surviving
+                    # y (so the map covers few rows), a trained stat model
+                    # repairs those cells instead of giving up on them.
+                    x_vals = train_df[fx[0]].dropna().astype(str)
+                    coverage = float(x_vals.isin(fd_map.keys()).mean()) \
+                        if len(x_vals) else 0.0
+                    if coverage >= 0.8:
+                        _logger.info(
+                            "Building {}/{} model... type=rule(FD: X->y)  y={}(|y|={}) "
+                            "X={}(|X|={})".format(
+                                index, len(target_columns), y, num_class_map[y],
+                                fx[0], domain_stats[fx[0]]))
+                        models[y] = (FunctionalDepModel(fx[0], fd_map), [fx[0]], None)
+                    else:
+                        _logger.info(
+                            f"Skipping FD rule for y={y} (X={fx[0]} covers only "
+                            f"{coverage:.0%} of rows); falling back to a stat model")
 
         if len(models) != len(target_columns):
             feature_map: Dict[str, List[str]] = {}
@@ -943,6 +957,10 @@ class RepairModel:
             # the stats that feed feature selection, model.* shape training.
             # (repair.pmf.* retrains unnecessarily but never reuses stale.)
             "opts": dict(sorted(self.opts.items())),
+            # Setter-based knobs that change which models get built.
+            "discrete_thres": int(self.discrete_thres),
+            "repair_by_rules": bool(self.repair_by_rules),
+            "rebalancing": bool(self.training_data_rebalancing_enabled),
         }
 
     def _load_model_checkpoint(self, fingerprint: Dict[str, Any]) -> Optional[List[Any]]:

@@ -14,6 +14,42 @@ import numpy as np
 import pandas as pd
 
 
+def f1_macro(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    """Macro-averaged F1 over the classes present in ``y_true`` — the CV
+    scorer for classifier model selection (the same metric the reference
+    feeds hyperopt, train.py:158). Shared by the sequential and batched CV
+    paths so their scores cannot diverge."""
+    classes = np.unique(y_true)
+    f1s = []
+    for c in classes:
+        tp = float(((y_pred == c) & (y_true == c)).sum())
+        fp = float(((y_pred == c) & (y_true != c)).sum())
+        fn = float(((y_pred != c) & (y_true == c)).sum())
+        p = tp / (tp + fp) if tp + fp > 0 else 0.0
+        r = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f1s.append(2 * p * r / (p + r) if p + r > 0 else 0.0)
+    return float(np.mean(f1s)) if f1s else 0.0
+
+
+def balanced_class_weights(counts: np.ndarray, n: int,
+                           damped: bool = True) -> np.ndarray:
+    """Balanced class weights, optionally square-root-damped.
+
+    The reference trains LightGBM with sklearn-style ``class_weight=
+    'balanced'`` (``n / (k * count)``, train.py:105). On dirty tables that
+    scheme gives one-row noise classes (undetected typos like 'yex'/'ax' in
+    hospital) weights hundreds of times larger than the majority class, so
+    repair-time predictions on masked rows collapse into typo leaves. With
+    ``damped=True`` (the GBDT head) the sqrt keeps the minority-vs-majority
+    ordering but compresses the ratio quadratically — minority recall stays,
+    typo classes stop winning. The logistic head uses ``damped=False`` (the
+    reference's exact scheme): its huge-cardinality targets depend on strong
+    minority upweighting (flights repair F1 drops measurably without it)."""
+    k = len(counts)
+    raw = n / (k * np.maximum(counts.astype(np.float64), 1.0))
+    return np.sqrt(raw) if damped else raw
+
+
 class FeatureEncoder:
     """fit/transform over pandas feature frames -> float32 [n, D]."""
 

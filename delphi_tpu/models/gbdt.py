@@ -17,7 +17,7 @@ balanced class weights like the reference's `class_weight='balanced'`
 """
 
 from functools import partial
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +61,10 @@ class _Binner:
 
     @property
     def n_bins(self) -> int:
-        return max((len(e) + 1 for e in self.edges), default=1) + 1  # +1 NaN bin
+        # Fixed at max_bin+1 (not the data-dependent max edge count) so every
+        # target column compiles against the same histogram width — one XLA
+        # program serves the whole per-attribute model loop.
+        return self.max_bin + 1
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         n, d = X.shape
@@ -79,11 +82,17 @@ class _Binner:
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "n_nodes"))
 def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
-                reg_lambda, min_split_gain, min_child_weight):
+                reg_lambda, min_split_gain, min_child_weight,
+                min_child_samples):
     """Grows one depth-wise tree. Returns (feat[int32 n_nodes-1],
     thr[int32 n_nodes-1], leaf[f32 n_nodes]) with all-left sentinel splits
-    (thr = n_bins) for terminated nodes."""
+    (thr = n_bins) for terminated nodes. Rows with weight 0 (padding /
+    held-out CV rows) are excluded from the row count: ``min_child_samples``
+    bounds the UNWEIGHTED participating rows per child (LightGBM's
+    min_child_samples, default 20) so heavily-upweighted rare classes cannot
+    carve single-row leaves."""
     n, d = bins.shape
+    counts = (weight > 0).astype(jnp.float32)
 
     feat = jnp.zeros(n_nodes - 1, dtype=jnp.int32)
     thr = jnp.full(n_nodes - 1, n_bins, dtype=jnp.int32)
@@ -101,19 +110,24 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
             jnp.repeat(hess, d)).reshape(n_level, d, n_bins)
         hw = jnp.zeros(size, jnp.float32).at[flat].add(
             jnp.repeat(weight, d)).reshape(n_level, d, n_bins)
+        hc = jnp.zeros(size, jnp.float32).at[flat].add(
+            jnp.repeat(counts, d)).reshape(n_level, d, n_bins)
 
         GL = jnp.cumsum(hg, axis=2)
         HL = jnp.cumsum(hh, axis=2)
         WL = jnp.cumsum(hw, axis=2)
+        CL = jnp.cumsum(hc, axis=2)
         G = GL[:, :, -1:]
         H = HL[:, :, -1:]
         W = WL[:, :, -1:]
-        GR, HR, WR = G - GL, H - HL, W - WL
+        C = CL[:, :, -1:]
+        GR, HR, WR, CR = G - GL, H - HL, W - WL, C - CL
 
         gain = (GL * GL / (HL + reg_lambda)
                 + GR * GR / (HR + reg_lambda)
                 - G * G / (H + reg_lambda))
-        ok = (WL >= min_child_weight) & (WR >= min_child_weight)
+        ok = (WL >= min_child_weight) & (WR >= min_child_weight) \
+            & (CL >= min_child_samples) & (CR >= min_child_samples)
         gain = jnp.where(ok, gain, -jnp.inf)
         # never split on the last bin (right side empty by construction)
         gain = gain.at[:, :, -1].set(-jnp.inf)
@@ -160,7 +174,8 @@ def _predict_tree(bins, feat, thr, leaf, depth):
 @partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
                                    "objective", "k"))
 def _boost(bins, y, weight, n_rounds, depth, n_bins, n_nodes, objective, k,
-           lr, reg_lambda, min_split_gain, min_child_weight, base_score):
+           lr, reg_lambda, min_split_gain, min_child_weight, base_score,
+           min_child_samples=20.0):
     """Runs the full boosting loop as one lax.scan; returns stacked trees."""
     n = bins.shape[0]
 
@@ -182,7 +197,8 @@ def _boost(bins, y, weight, n_rounds, depth, n_bins, n_nodes, objective, k,
 
         def build(gk, hk):
             return _build_tree(bins, gk, hk, weight, depth, n_bins, n_nodes,
-                               reg_lambda, min_split_gain, min_child_weight)
+                               reg_lambda, min_split_gain, min_child_weight,
+                               min_child_samples)
 
         feat, thr, leaf, node = jax.vmap(build)(g, h)  # [k_trees, ...]
         leaf = leaf * lr
@@ -221,6 +237,193 @@ def _predict_boosted(bins, feats, thrs, leaves, n_rounds, depth, objective, k,
 
 
 # ---------------------------------------------------------------------------
+# Batched cross-validation grid search
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
+                                   "objective", "k"))
+def _boost_and_score_batch(bins, y, weights, n_rounds, depth, n_bins, n_nodes,
+                           objective, k, lrs, reg_lambdas, min_split_gains,
+                           min_child_weights, bases):
+    """Trains one boosted model per (config, fold) instance — each instance
+    carries its own bin tensor, targets, per-row weights and scalar
+    hyperparameters — then scores every instance on the full row set in one
+    vmapped program. The sequential hyperopt×CV loop of the reference
+    (train.py:163-209) becomes a single XLA launch."""
+
+    def one(bins_i, y_i, weight, lr, reg_lambda, min_split_gain,
+            min_child_weight, base):
+        trees = _boost(bins_i, y_i, weight, n_rounds, depth, n_bins, n_nodes,
+                       objective, k, lr, reg_lambda, min_split_gain,
+                       min_child_weight, base, 0.0)
+        return _predict_boosted(bins_i, *trees, n_rounds, depth, objective, k,
+                                base)
+
+    return jax.vmap(one)(bins, y, weights, lrs, reg_lambdas, min_split_gains,
+                         min_child_weights, bases)
+
+
+def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
+                        num_class: int, configs: List[dict], n_splits: int,
+                        max_bin: int, class_weight: str,
+                        template: "GradientBoostedTreesModel") -> Tuple[int, float]:
+    """K-fold CV over a hyperparameter grid in one batched device launch per
+    static-shape group (configs sharing tree depth and round count vmap
+    together; others get their own launch).
+
+    Returns (best config index, its mean CV score). Scores match the
+    sequential path's metrics: macro-F1 for classifiers, -MSE for regressors
+    (the scorers the reference feeds hyperopt, train.py:158). Each fold bins
+    (and, for regression, log-transforms) from its training rows only, so an
+    instance's scores match a standalone per-fold fit.
+    """
+    Xm = template._as_matrix(X)
+    n = Xm.shape[0]
+    n_bins = template.max_bin + 1
+
+    y_arr = np.asarray(y)
+    if is_discrete:
+        codes, classes = pd.factorize(y_arr, sort=True)
+        k_real = len(classes)
+        counts = np.bincount(codes, minlength=k_real).astype(np.float64)
+        if class_weight == "balanced":
+            from delphi_tpu.models.encoding import balanced_class_weights
+            w_full = balanced_class_weights(counts, len(codes))[codes]
+        else:
+            w_full = np.ones(n)
+        if k_real <= 2:
+            objective, k = "binary", 1
+        else:
+            objective = "multiclass"
+            k = next(b for b in (4, 8, 16, 24, MAX_MULTICLASS) if b >= k_real)
+        yv = codes.astype(np.float32)
+    else:
+        objective, k, k_real = "regression", 1, 0
+        yv64 = pd.to_numeric(pd.Series(y_arr), errors="coerce") \
+            .to_numpy(dtype=np.float64)
+        w_full = np.ones(n)
+
+    def cfg_depth(cfg: dict) -> int:
+        return int(cfg.get("max_depth", template.max_depth))
+
+    def cfg_rounds(cfg: dict) -> int:
+        r = min(int(cfg.get("n_estimators", 200)), 200)
+        if objective == "multiclass":
+            r = min(r, max(40, 400 // k))
+        return r
+
+    rng = np.random.RandomState(42)
+    order = rng.permutation(n)
+    folds = np.array_split(order, max(2, min(n_splits, n)))
+    folds = [f for f in folds if len(f)]
+
+    # Per-fold preprocessing matches a standalone fit on the fold's training
+    # rows exactly: bin edges (and, for regression, the log-target decision)
+    # come from the training rows only; all rows are then transformed with
+    # the fold's edges so held-out predictions fall out of the same program.
+    fold_bins, fold_y, fold_log = [], [], []
+    for fold in folds:
+        train_mask = np.ones(n, dtype=bool)
+        train_mask[fold] = False
+        binner_f = _Binner(template.max_bin).fit(Xm[train_mask])
+        fold_bins.append(template._pad(template._pad_feature_dim(
+            binner_f.transform(Xm))))
+        if is_discrete:
+            fold_y.append(template._pad(yv))
+            fold_log.append(False)
+        else:
+            ytr = yv64[train_mask]
+            std = ytr.std()
+            skew = float(((ytr - ytr.mean()) ** 3).mean() / (std ** 3)) \
+                if std > 0 else 0.0
+            log_f = bool((ytr >= 0).all() and skew > 2.0)
+            yv_f = (np.log1p(yv64) if log_f else yv64).astype(np.float32)
+            fold_y.append(template._pad(yv_f))
+            fold_log.append(log_f)
+
+    # Configs sharing (depth, rounds) vmap into one launch; configs that
+    # differ in those STATIC dims (tree tensor shapes change) form separate
+    # groups, each still a single launch — every config is trained with its
+    # own true hyperparameters.
+    from delphi_tpu.models.encoding import f1_macro
+
+    groups: Dict[Tuple[int, int], List[int]] = {}
+    for ci, cfg in enumerate(configs):
+        groups.setdefault((cfg_depth(cfg), cfg_rounds(cfg)), []).append(ci)
+
+    per_config: Dict[int, List[float]] = {}
+    for (g_depth, g_rounds), cfg_indices in groups.items():
+        binss, ys, weights, lrs, regs, msgs, mcws, bases, metas = \
+            [], [], [], [], [], [], [], [], []
+        for ci in cfg_indices:
+            cfg = configs[ci]
+            for fi, fold in enumerate(folds):
+                train_mask = np.ones(n, dtype=bool)
+                train_mask[fold] = False
+                if is_discrete and len(np.unique(yv[train_mask])) < 2:
+                    continue
+                w = np.where(train_mask, w_full, 0.0).astype(np.float32)
+                yv_f = fold_y[fi][:n]
+                if objective == "binary":
+                    pos = float((w * yv_f).sum() / max(w.sum(), 1e-9))
+                    pos = min(max(pos, 1e-6), 1 - 1e-6)
+                    base = np.array([np.log(pos / (1 - pos))], dtype=np.float32)
+                elif objective == "multiclass":
+                    priors = np.zeros(k)
+                    np.add.at(priors, yv_f.astype(np.int64), w)
+                    priors = np.maximum(priors / max(priors.sum(), 1e-9), 1e-13)
+                    base = np.log(priors).astype(np.float32)
+                else:
+                    base = np.array(
+                        [float((w * yv_f).sum() / max(w.sum(), 1e-9))], np.float32)
+                binss.append(fold_bins[fi])
+                ys.append(fold_y[fi])
+                weights.append(template._pad(w))
+                lrs.append(cfg.get("learning_rate", 0.1))
+                regs.append(cfg.get("reg_lambda", 1.0))
+                msgs.append(template.min_split_gain)
+                mcws.append(cfg.get("min_child_weight", 1.0))
+                bases.append(base)
+                metas.append((ci, fi, fold))
+
+        if not metas:
+            continue
+
+        F = _boost_and_score_batch(
+            jnp.asarray(np.stack(binss)), jnp.asarray(np.stack(ys)),
+            jnp.asarray(np.stack(weights)), g_rounds, g_depth, n_bins,
+            1 << g_depth, objective, k,
+            jnp.asarray(np.asarray(lrs, np.float32)),
+            jnp.asarray(np.asarray(regs, np.float32)),
+            jnp.asarray(np.asarray(msgs, np.float32)),
+            jnp.asarray(np.asarray(mcws, np.float32)),
+            jnp.asarray(np.stack(bases)))
+        F = np.asarray(jax.device_get(F))[..., :n]  # [B, (k,) n]
+
+        for b, (ci, fi, fold) in enumerate(metas):
+            if objective == "multiclass":
+                pred_codes = F[b][:k_real].argmax(axis=0)[fold]
+            elif objective == "binary":
+                pred_codes = (F[b][fold] > 0).astype(np.int64)
+            if is_discrete:
+                truth = y_arr[fold].astype(str)
+                pred = classes[np.minimum(pred_codes, k_real - 1)].astype(str)
+                score = f1_macro(truth, pred)
+            else:
+                pred = F[b][fold]
+                if fold_log[fi]:
+                    pred = np.expm1(pred)
+                score = -float(((pred - yv64[fold]) ** 2).mean())
+            per_config.setdefault(ci, []).append(score)
+
+    if not per_config:
+        return 0, -np.inf
+    mean_scores = {ci: float(np.mean(s)) for ci, s in per_config.items()}
+    best_ci = max(mean_scores, key=lambda ci: mean_scores[ci])
+    return best_ci, mean_scores[best_ci]
+
+
+# ---------------------------------------------------------------------------
 # Public model
 # ---------------------------------------------------------------------------
 
@@ -232,6 +435,7 @@ class GradientBoostedTreesModel:
                  max_depth: int = 5, max_bin: int = 255,
                  min_split_gain: float = 0.0, reg_lambda: float = 1.0,
                  min_child_weight: float = 1.0,
+                 min_child_samples: float = 0.0,
                  class_weight: str = "balanced") -> None:
         self.is_discrete = is_discrete
         self.num_class = num_class
@@ -242,6 +446,7 @@ class GradientBoostedTreesModel:
         self.min_split_gain = min_split_gain
         self.reg_lambda = reg_lambda
         self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
         self.class_weight = class_weight
         self.loss_: float = 0.0
         self._classes: Optional[np.ndarray] = None
@@ -267,11 +472,26 @@ class GradientBoostedTreesModel:
         pad_shape = (target - n,) + arr.shape[1:]
         return np.concatenate([arr, np.full(pad_shape, value, arr.dtype)], axis=0)
 
+    @staticmethod
+    def _pad_feature_dim(bins: np.ndarray) -> np.ndarray:
+        """Pads the feature axis to the next multiple of 8 so per-attribute
+        models with nearly-equal feature counts share one compiled program.
+        Padded features are constant (NaN bin 0): their best split gain is
+        exactly 0, which never beats ``gain > min_split_gain``, so they are
+        dead weight in the histogram only — never chosen."""
+        d = bins.shape[1]
+        target = max(8, -(-d // 8) * 8)
+        if target == d:
+            return bins
+        return np.concatenate(
+            [bins, np.zeros((bins.shape[0], target - d), bins.dtype)], axis=1)
+
     def fit(self, X: Any, y: Any) -> "GradientBoostedTreesModel":
         Xm = self._as_matrix(X)
         n, d = Xm.shape
         self._binner = _Binner(self.max_bin).fit(Xm)
-        bins = jnp.asarray(self._pad(self._binner.transform(Xm)))
+        bins = jnp.asarray(self._pad(self._pad_feature_dim(
+            self._binner.transform(Xm))))
         self._n_bins = self._binner.n_bins
         self._n_nodes = 1 << self.max_depth
 
@@ -281,9 +501,13 @@ class GradientBoostedTreesModel:
             k = len(classes)
             counts = np.bincount(codes, minlength=k).astype(np.float64)
             if self.class_weight == "balanced":
-                w = (len(codes) / (k * np.maximum(counts, 1.0)))[codes]
+                from delphi_tpu.models.encoding import balanced_class_weights
+                per_class_w = balanced_class_weights(counts, len(codes))
+                w = per_class_w[codes]
+                self._fit_class_weights = per_class_w
             else:
                 w = np.ones(n)
+                self._fit_class_weights = None
             if k <= 2:
                 self._objective = "binary"
                 self._k = 1
@@ -293,13 +517,19 @@ class GradientBoostedTreesModel:
                 base = np.array([np.log(pos / (1 - pos))], dtype=np.float32)
             else:
                 self._objective = "multiclass"
-                self._k = k
+                # Bucket the class-tree axis ({4,8,16,24}) so targets with
+                # similar cardinality share one compiled boosting program;
+                # padded classes get a ~-inf prior and are never the label,
+                # so their gradients (and trees) are zero.
+                k_pad = next(b for b in (4, 8, 16, 24, MAX_MULTICLASS)
+                             if b >= k)
+                self._k = k_pad
                 # bound the k-trees-per-round cost
-                self.n_estimators = min(self.n_estimators, max(40, 400 // k))
+                self.n_estimators = min(self.n_estimators, max(40, 400 // k_pad))
                 yv = codes.astype(np.float32)
-                priors = np.zeros(k)
+                priors = np.zeros(k_pad)
                 np.add.at(priors, codes, w)
-                priors = np.maximum(priors / priors.sum(), 1e-9)
+                priors = np.maximum(priors / priors.sum(), 1e-13)
                 base = np.log(priors).astype(np.float32)
         else:
             self._objective = "regression"
@@ -327,14 +557,20 @@ class GradientBoostedTreesModel:
             self.n_estimators, self.max_depth, self._n_bins, self._n_nodes,
             self._objective, max(self._k, 1),
             self.learning_rate, self.reg_lambda, self.min_split_gain,
-            self.min_child_weight, jnp.asarray(base))
+            self.min_child_weight, jnp.asarray(base),
+            # Optional leaf row-count floor (LightGBM's min_child_samples).
+            # Default 0: prior recalibration in predict_proba already guards
+            # against upweighted rare typo classes, and a hard floor costs
+            # accuracy on tight local structure (e.g. boston RAD).
+            self.min_child_samples if self.is_discrete else 0.0)
         self._trees = jax.device_get(trees)
         return self
 
     def _raw_scores(self, X: Any) -> np.ndarray:
         Xm = self._as_matrix(X)
         n = Xm.shape[0]
-        bins = jnp.asarray(self._pad(self._binner.transform(Xm)))
+        bins = jnp.asarray(self._pad(self._pad_feature_dim(
+            self._binner.transform(Xm))))
         feats, thrs, leaves = (jnp.asarray(t) for t in self._trees)
         F = _predict_boosted(bins, feats, thrs, leaves, self.n_estimators,
                              self.max_depth, self._objective, max(self._k, 1),
@@ -342,15 +578,32 @@ class GradientBoostedTreesModel:
         F = np.asarray(F)
         return F[..., :n]
 
+    def _recalibrate(self, probs: np.ndarray) -> np.ndarray:
+        """Importance-corrects probabilities back to the TRUE class priors.
+
+        Training reweights classes (balanced weights w_c), so the model
+        estimates p_q(y|x) under the reweighted distribution q(y) ∝
+        count_c * w_c. Dividing by w_c and renormalizing recovers
+        p(y|x) under the empirical priors — so ultra-rare noise classes
+        (undetected typos) keep their minority recall during training but
+        cannot win ambiguous repair predictions on priors they don't have."""
+        w = getattr(self, "_fit_class_weights", None)
+        if w is None:
+            return probs
+        corrected = probs / np.maximum(w[None, :], 1e-12)
+        return corrected / np.maximum(
+            corrected.sum(axis=1, keepdims=True), 1e-12)
+
     def predict_proba(self, X: Any) -> np.ndarray:
         assert self.is_discrete
         F = self._raw_scores(X)
         if self._objective == "binary":
             p = 1.0 / (1.0 + np.exp(-F))
-            return np.stack([1 - p, p], axis=1)
+            return self._recalibrate(np.stack([1 - p, p], axis=1))
+        F = F[: len(self.classes_)]  # drop padded bucket classes
         z = F - F.max(axis=0, keepdims=True)
         e = np.exp(z)
-        return (e / e.sum(axis=0, keepdims=True)).T
+        return self._recalibrate((e / e.sum(axis=0, keepdims=True)).T)
 
     def predict(self, X: Any) -> np.ndarray:
         if self.is_discrete:

@@ -35,6 +35,18 @@ def _pad_rows(X: np.ndarray, *arrays: np.ndarray):
     return Xp, outs, mask
 
 
+def _pad_cols(X: np.ndarray) -> np.ndarray:
+    """Pads the feature axis to the next power of two so the per-attribute
+    training loop reuses one compiled program across one-hot widths; padded
+    columns are all-zero, so their weights only see the L2 pull and stay 0."""
+    d = X.shape[1]
+    target = max(8, 1 << (d - 1).bit_length())
+    if target == d:
+        return X
+    return np.concatenate(
+        [X, np.zeros((X.shape[0], target - d), X.dtype)], axis=1)
+
+
 @partial(jax.jit, static_argnames=("n_steps",))
 def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps):
     n, d = X.shape
@@ -132,10 +144,17 @@ class LogisticRegressionModel:
         assert (codes >= 0).all(), "y must not contain NULLs"
         self._classes = np.asarray(classes)
         k = len(classes)
-        counts = np.bincount(codes, minlength=k).astype(np.float32)
-        class_weights = len(codes) / (k * np.maximum(counts, 1.0))
+        # Bucket the class axis to the next multiple of 8 (shared compiled
+        # program across targets); padded classes have weight 0 and are never
+        # a label, so they only add dead softmax columns.
+        k_pad = max(8, -(-k // 8) * 8)
+        counts = np.bincount(codes, minlength=k_pad).astype(np.float32)
+        class_weights = np.zeros(k_pad, np.float32)
+        from delphi_tpu.models.encoding import balanced_class_weights
+        class_weights[:k] = balanced_class_weights(
+            counts[:k], len(codes), damped=False)
 
-        Xp, (yp,), mask = _pad_rows(np.asarray(X, np.float32),
+        Xp, (yp,), mask = _pad_rows(_pad_cols(np.asarray(X, np.float32)),
                                     codes.astype(np.int32))
         params, loss = _fit_logreg(
             jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
@@ -147,9 +166,17 @@ class LogisticRegressionModel:
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
         assert self._params is not None
         W, b = self._params
-        logits = np.asarray(X, np.float32) @ W + b
+        k = len(self.classes_)
+        logits = _pad_cols(np.asarray(X, np.float32)) @ W + b
+        logits = logits[:, :k]  # drop padded bucket classes
         logits -= logits.max(axis=1, keepdims=True)
         e = np.exp(logits)
+        # NOTE: no prior recalibration here, unlike the GBDT head. The
+        # logistic head serves huge-cardinality targets whose true repairs
+        # are often rare values (e.g. flights times); correcting toward the
+        # empirical priors measurably hurts repair F1 there, while the typo-
+        # class failure mode it guards against lives in low-cardinality
+        # GBDT targets.
         return e / e.sum(axis=1, keepdims=True)
 
     def predict(self, X: np.ndarray) -> np.ndarray:
@@ -184,7 +211,7 @@ class MLPRegressorModel:
         self._y_std = float(yv.std()) or 1.0
         yn = ((yv - self._y_mean) / self._y_std).astype(np.float32)
 
-        Xp, (yp,), mask = _pad_rows(np.asarray(X, np.float32), yn)
+        Xp, (yp,), mask = _pad_rows(_pad_cols(np.asarray(X, np.float32)), yn)
         params, loss = _fit_mlp_regressor(
             jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
             self.l2, self.lr, self.n_steps, self.hidden, self.seed)
@@ -194,7 +221,9 @@ class MLPRegressorModel:
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         assert self._params is not None
-        pred = np.asarray(_mlp_forward(self._params, jnp.asarray(X, dtype=jnp.float32)))
+        pred = np.asarray(_mlp_forward(
+            self._params,
+            jnp.asarray(_pad_cols(np.asarray(X, np.float32)))))
         return pred * self._y_std + self._y_mean
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:

@@ -140,9 +140,35 @@ def compute_freq_stats(table: EncodedTable,
     needed = list(dict.fromkeys(attrs + [a for p in pairs for a in p]))
     v_pad = max((vocab_sizes[a] for a in needed), default=0)
 
-    codes = jnp.asarray(table.codes(needed))
+    codes_np = table.codes(needed)
     name_to_idx = {a: i for i, a in enumerate(needed)}
 
+    # Multi-device path: when a mesh is active (DELPHI_MESH / repair.mesh),
+    # the same reductions run row-sharded over the dp axis with psum over
+    # ICI replacing the Spark shuffle (SURVEY.md §2.3 P1).
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+    if mesh is not None:
+        from delphi_tpu.parallel.sharded import (
+            sharded_pair_counts, sharded_single_counts)
+
+        singles_arr = sharded_single_counts(codes_np, v_pad, mesh)
+        singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1]
+                   for a in needed}
+        pair_mats = {}
+        if pairs:
+            idx_pairs = [(name_to_idx[x], name_to_idx[y]) for x, y in pairs]
+            flat = sharded_pair_counts(codes_np, idx_pairs, v_pad, mesh)
+            stride = v_pad + 1
+            for p, (x, y) in enumerate(pairs):
+                m = flat[p].reshape(stride, stride)
+                pair_mats[(x, y)] = m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
+        return FreqStats(
+            n_rows=table.n_rows, attrs=attrs, vocab_sizes=vocab_sizes,
+            singles=singles, pairs=pair_mats,
+            threshold_count=int(table.n_rows * attr_freq_ratio_threshold))
+
+    codes = jnp.asarray(codes_np)
     singles_arr = np.asarray(_batched_single_counts(codes, v_pad))
     singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
 

@@ -1,10 +1,43 @@
 """Mesh construction and row sharding helpers."""
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_active_mesh_cache: dict = {}
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    """The mesh the PIPELINE's stats kernels run on, or None for the
+    single-device path. Opt-in: set ``DELPHI_MESH=auto`` (all local devices
+    when more than one) or ``DELPHI_MESH=<n>`` (first n devices), or the
+    session config key ``repair.mesh`` with the same values. This is the
+    switch that turns the engine's reductions into psum'd SPMD programs
+    (SURVEY.md §2.3 P1) without touching user code."""
+    setting = os.environ.get("DELPHI_MESH", "")
+    if not setting:
+        from delphi_tpu.session import get_session
+        setting = get_session().conf.get("repair.mesh", "")
+    setting = setting.strip().lower()
+    if setting in ("", "0", "off", "none"):
+        return None
+    if setting != "auto" and not setting.isdigit():
+        raise ValueError(
+            f"DELPHI_MESH / repair.mesh must be 'auto', a device count, or "
+            f"'0'/'off' to disable, but '{setting}' found")
+    key = setting
+    if key not in _active_mesh_cache:
+        n_devices = None if setting == "auto" else int(setting)
+        available = len(jax.devices())
+        if n_devices is None and available <= 1:
+            _active_mesh_cache[key] = None
+        else:
+            _active_mesh_cache[key] = make_mesh(
+                min(n_devices, available) if n_devices else None)
+    return _active_mesh_cache[key]
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -77,16 +77,8 @@ train_option_keys = [
 
 
 def _f1_macro(y_true: np.ndarray, y_pred: np.ndarray) -> float:
-    classes = np.unique(y_true)
-    f1s = []
-    for c in classes:
-        tp = float(((y_pred == c) & (y_true == c)).sum())
-        fp = float(((y_pred == c) & (y_true != c)).sum())
-        fn = float(((y_pred != c) & (y_true == c)).sum())
-        p = tp / (tp + fp) if tp + fp > 0 else 0.0
-        r = tp / (tp + fn) if tp + fn > 0 else 0.0
-        f1s.append(2 * p * r / (p + r) if p + r > 0 else 0.0)
-    return float(np.mean(f1s)) if f1s else 0.0
+    from delphi_tpu.models.encoding import f1_macro
+    return f1_macro(y_true, y_pred)
 
 
 def _cv_score(make_model, X: np.ndarray, y: pd.Series, is_discrete: bool,
@@ -141,7 +133,8 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
         return get_option_value(opts, *args)
 
     try:
-        from delphi_tpu.models.gbdt import GradientBoostedTreesModel, gbdt_supported
+        from delphi_tpu.models.gbdt import (
+            GradientBoostedTreesModel, gbdt_cv_grid_search, gbdt_supported)
         n_splits = int(opt(*_opt_n_splits))
         max_evals = int(opt(*_opt_max_evals))
         class_weight = str(opt(*_opt_class_weight))
@@ -163,10 +156,13 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 grid = grid[:1]
             best_cfg, best_score = grid[0], -np.inf
             if len(grid) > 1 and len(X) >= n_splits * 2:
-                for cfg in grid:
-                    score = _cv_score(factory(cfg), X, y, is_discrete, n_splits)
-                    if score > best_score:
-                        best_cfg, best_score = cfg, score
+                # every (config, fold) instance trains in ONE vmapped XLA
+                # launch instead of the reference's sequential hyperopt loop
+                template = factory(grid[0])()
+                best_ci, best_score = gbdt_cv_grid_search(
+                    X, y, is_discrete, num_class, grid, n_splits,
+                    int(opt(*_opt_max_bin)), class_weight, template)
+                best_cfg = grid[best_ci]
             model = factory(best_cfg)()
             model.fit(X, y)
             return model, best_score if np.isfinite(best_score) else -model.loss_

@@ -94,7 +94,7 @@ def test_gbdt_histogram_round_matches_single_device():
     from delphi_tpu.models.gbdt import _build_tree
     f2, t2, l2, node2 = _build_tree(
         jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
-        jnp.asarray(hess), depth, B, 1 << depth, 1.0, 0.0, 0.0)
+        jnp.asarray(hess), depth, B, 1 << depth, 1.0, 0.0, 0.0, 0.0)
     np.testing.assert_array_equal(np.asarray(feat), np.asarray(f2))
     np.testing.assert_array_equal(np.asarray(thr), np.asarray(t2))
     np.testing.assert_allclose(np.asarray(leaf), np.asarray(l2) * 0.1,
@@ -111,3 +111,31 @@ def test_sharded_freq_equals_ops_freq(adult_df, mesh):
     for j, name in enumerate(names):
         np.testing.assert_array_equal(
             counts[j, : table.column(name).domain_size + 1], stats.single(name))
+
+
+def test_pipeline_runs_on_mesh(adult_df, monkeypatch):
+    """End-to-end repair with the stats engine routed over the 8-device mesh
+    (`DELPHI_MESH=auto`) must produce exactly the single-device repairs."""
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.parallel import mesh as mesh_mod
+
+    session_name = "adult_mesh_e2e"
+    delphi.register_table(session_name, adult_df)
+
+    def run():
+        return delphi.repair \
+            .setTableName(session_name).setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]).run() \
+            .sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+    base = run()
+    monkeypatch.setenv("DELPHI_MESH", "auto")
+    mesh_mod._active_mesh_cache.clear()
+    try:
+        on_mesh = run()
+    finally:
+        monkeypatch.delenv("DELPHI_MESH")
+        mesh_mod._active_mesh_cache.clear()
+
+    pd.testing.assert_frame_equal(base, on_mesh)
+    assert len(base) > 0
