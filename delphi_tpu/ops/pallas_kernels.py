@@ -100,12 +100,12 @@ def _pair_counts_padded(x_codes: jnp.ndarray, y_codes: jnp.ndarray,
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((_ROW_TILE, 1), lambda i: (i, 0),
-                         memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
             pl.BlockSpec((_ROW_TILE, 1), lambda i: (i, 0),
-                         memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((vx_pad, vy_pad), lambda i: (0, 0),
-                               memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+                               memory_space=pl.ANY if interpret else pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((vx_pad, vy_pad), jnp.float32),
         cost_estimate=pl.CostEstimate(
             flops=2 * n_tiles * _ROW_TILE * vx_pad * vy_pad,
@@ -179,10 +179,10 @@ def _entropy_call(buf: jnp.ndarray, n_rows_arr: jnp.ndarray,
     return pl.pallas_call(
         _entropy_kernel,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, 8), jnp.float32),
         interpret=interpret,
     )(buf, n_rows_arr)
