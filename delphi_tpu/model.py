@@ -100,29 +100,34 @@ def repair_attrs_from(updates_df: pd.DataFrame, base_df: pd.DataFrame,
             f"Table must have '{row_id}', 'attribute', and 'repaired' columns")
 
     out = base_df.copy()
-    index_of = {rid: i for i, rid in enumerate(out[row_id].tolist())}
+    row_index = pd.Index(out[row_id])
     for attr, group in updates_df.groupby("attribute"):
         if attr not in out.columns:
             continue
-        rows, values = [], []
-        for rid, rep in zip(group[row_id], group["repaired"]):
-            if rid not in index_of:
-                continue
-            rows.append(index_of[rid])
-            if attr in continuous_cols and rep is not None and not pd.isna(rep):
-                kind = continuous_cols[attr]
-                rep = float(rep)
-                if kind == KIND_INTEGRAL:
-                    rep = int(round(rep))
-            values.append(rep)
-        if rows:
-            col = out[attr].copy()
-            if pd.api.types.is_integer_dtype(col.dtype) and any(pd.isna(v) for v in values):
-                col = col.astype("float64")
-            elif pd.api.types.is_integer_dtype(col.dtype):
-                values = [int(v) for v in values]
-            col.iloc[rows] = values
-            out[attr] = col
+        pos = row_index.get_indexer(group[row_id])
+        present = pos >= 0
+        rows = pos[present]
+        if not len(rows):
+            continue
+        reps = pd.Series(group["repaired"].to_numpy(dtype=object)[present],
+                         dtype=object)
+        non_null = reps.notna().to_numpy()
+        if attr in continuous_cols and non_null.any():
+            conv = reps[non_null].astype(float)
+            if continuous_cols[attr] == KIND_INTEGRAL:
+                conv = conv.round().astype("int64")
+            reps = reps.copy()
+            reps[non_null] = conv.astype(object)
+        values = reps.to_numpy(dtype=object)
+        col = out[attr].copy()
+        if pd.api.types.is_integer_dtype(col.dtype) and not non_null.all():
+            col = col.astype("float64")
+        elif pd.api.types.is_integer_dtype(col.dtype):
+            values = pd.Series(values).astype("int64").to_numpy()
+        # assign as a list: pandas accepts elementwise coercion for lists
+        # where it rejects whole object-dtype arrays
+        col.iloc[rows] = list(values)
+        out[attr] = col
     return out
 
 
@@ -423,27 +428,45 @@ class RepairModel:
             for c in targets
         }
 
-        repaired_rows = []
-        keep_rows = []
-        for _, row in error_cells_df.iterrows():
-            attr = row["attribute"]
-            cur = row["current_value"]
-            dvs = domains.get(attr)
-            if dvs and cur is not None:
-                costs = self.cf.compute_many(cur, dvs)
-                scored = sorted(
-                    ((c, v) for c, v in zip(costs, dvs) if c is not None))
-                if len(scored) >= 2 and scored[0][0] <= merge_threshold \
-                        and scored[0][0] < scored[1][0]:
-                    repaired_rows.append({**row.to_dict(), "repaired": scored[0][1]})
-                    continue
-            keep_rows.append(row)
+        # One nearest-value resolution per unique (attribute, current value):
+        # every duplicate dirty cell reuses it, and each resolution is one
+        # batched (native) Levenshtein call over the whole domain.
+        ec = error_cells_df.reset_index(drop=True)
+        attrs = ec["attribute"].to_numpy(dtype=object)
+        curs = ec["current_value"].to_numpy(dtype=object)
+        repaired_vals = np.full(len(ec), None, dtype=object)
+        resolved: Dict[Tuple[str, Any], Optional[str]] = {}
+        for i in range(len(ec)):
+            dvs = domains.get(attrs[i])
+            cur = curs[i]
+            if not dvs or cur is None:
+                continue
+            key = (attrs[i], cur)
+            if key not in resolved:
+                resolved[key] = self._nearest_value(cur, dvs, merge_threshold)
+            repaired_vals[i] = resolved[key]
 
-        repaired_df = pd.DataFrame(repaired_rows) if repaired_rows \
-            else self._empty_repaired_cells_frame()
-        error_df = pd.DataFrame(keep_rows).reset_index(drop=True) if keep_rows \
+        mask = np.array([v is not None for v in repaired_vals], dtype=bool)
+        repaired_df = ec[mask].assign(repaired=repaired_vals[mask]) \
+            if mask.any() else self._empty_repaired_cells_frame()
+        error_df = ec[~mask].reset_index(drop=True) if (~mask).any() \
             else error_cells_df.iloc[0:0]
         return error_df, repaired_df
+
+    def _nearest_value(self, cur: Any, dvs: List[str],
+                       merge_threshold: float) -> Optional[str]:
+        """The reference's per-cell scan (model.py:583-622 analog): repair to
+        the unique lowest-cost domain value when it is under the merge
+        threshold and strictly beats the runner-up."""
+        assert self.cf is not None
+        costs = self.cf.compute_many(cur, dvs)
+        if costs is None:
+            return None
+        scored = sorted(((c, v) for c, v in zip(costs, dvs) if c is not None))
+        if len(scored) >= 2 and scored[0][0] <= merge_threshold \
+                and scored[0][0] < scored[1][0]:
+            return scored[0][1]
+        return None
 
     def _repair_by_rules(self, repair_base_df: pd.DataFrame,
                          error_cells_df: pd.DataFrame, target_columns: List[str],
@@ -721,28 +744,33 @@ class RepairModel:
 
         pdf = dirty_rows_df.reset_index(drop=True).copy()
         for y, (model, features, transformers) in models:
-            X: Any = pdf[features]
+            missing = pdf[y].isna()
+            miss_idx = np.nonzero(missing.to_numpy())[0]
+            if len(miss_idx) == 0:
+                continue
+
+            # Inference only over the rows whose y cell actually needs a
+            # repair — the clean cells of the dirty block keep their values.
+            X: Any = pdf[features].iloc[miss_idx]
             if transformers:
                 for transformer in transformers:
                     X = transformer.transform(X)
 
-            missing = pdf[y].isna()
             if need_pmf and y not in continuous_columns:
                 predicted = model.predict_proba(X)
+                classes_str = [str(c) for c in model.classes_.tolist()]
 
                 def _to_pmf(probs: Any) -> Dict[str, Any]:
                     if probs is None:
                         return {"classes": [], "probs": []}
-                    return {"classes": [str(c) for c in model.classes_.tolist()],
-                            "probs": list(map(float, probs))}
+                    return {"classes": classes_str,
+                            "probs": np.asarray(probs, dtype=np.float64)}
 
-                pmf = [_to_pmf(p) for p in predicted]
                 filled = pdf[y].astype(object)
-                filled[missing] = [pmf[i] for i in np.nonzero(missing.to_numpy())[0]]
+                filled.iloc[miss_idx] = [_to_pmf(p) for p in predicted]
                 pdf[y] = filled
             else:
                 predicted = np.asarray(model.predict(X))
-                miss_idx = np.nonzero(missing.to_numpy())[0]
                 if y in integral_columns:
                     vals = np.round(pd.to_numeric(
                         pd.Series(predicted), errors="coerce").to_numpy())
@@ -754,106 +782,151 @@ class RepairModel:
                 else:
                     vals = predicted.astype(object)
                     filled = pdf[y].astype(object)
-                filled.iloc[miss_idx] = vals[miss_idx]
+                filled.iloc[miss_idx] = vals
                 pdf[y] = filled
         return pdf
 
     def _flatten(self, df: pd.DataFrame) -> pd.DataFrame:
         """(row_id, attribute, value) long view (RepairMiscApi.scala:41-49);
-        values keep their python objects (PMF dicts pass through)."""
-        records = []
+        values keep their python objects (PMF dicts pass through). Column-
+        vectorized: homogeneous columns convert with pandas ops, only
+        mixed/object columns fall back to a per-element pass."""
         cols = [c for c in df.columns if c != self._row_id]
-        for _, row in df.iterrows():
-            for c in cols:
-                v = row[c]
-                if v is not None and not isinstance(v, dict) and pd.isna(v):
-                    v = None
-                elif isinstance(v, (int, np.integer)):
-                    v = str(int(v))
-                elif isinstance(v, (float, np.floating)):
-                    v = str(float(v))
-                elif not isinstance(v, dict) and v is not None:
-                    v = str(v)
-                records.append((row[self._row_id], c, v))
-        return pd.DataFrame(records, columns=[self._row_id, "attribute", "value"])
+        n = len(df)
+        mat = np.empty((n, len(cols)), dtype=object)
+        for j, c in enumerate(cols):
+            mat[:, j] = _flatten_column(df[c])
+        return pd.DataFrame({
+            self._row_id: np.repeat(df[self._row_id].to_numpy(dtype=object),
+                                    len(cols)),
+            "attribute": np.tile(np.array(cols, dtype=object), n),
+            "value": mat.reshape(-1),
+        }, columns=[self._row_id, "attribute", "value"])
 
-    def _compute_weighted_probs(self, pmf_rows: List[Dict[str, Any]]) \
-            -> List[Dict[str, Any]]:
-        assert self.cf is not None
-        weight = float(self._get_option_value(*self._opt_cost_weight))
-        cf_targets = set(self.cf.targets)
-        if cf_targets:
-            _logger.info(f"[Repairing Phase] {self.cf} computing weighting probs...")
-        for rec in pmf_rows:
-            if cf_targets and rec["attribute"] not in cf_targets:
-                continue
-            costs = self.cf.compute_many(rec["current_value"], rec["classes"])
-            if costs is not None:
-                rec["probs"] = [
-                    p * (1.0 / (1.0 + weight * c)) if c is not None else p
-                    for p, c in zip(rec["probs"], costs)]
-            total = sum(rec["probs"])
-            if total > 0:
-                rec["probs"] = [p / total for p in rec["probs"]]
-        return pmf_rows
+    def _pmf_records_for_attr(self, attr: str, group: pd.DataFrame,
+                              weighted: bool, weight: float,
+                              threshold: float, top_k: int) -> np.ndarray:
+        """Builds the per-cell PMF records of one attribute as matrix ops:
+        all cells of an attribute share one model, hence one class list, so
+        their probs stack into an (n, k) matrix. Cost weighting batches the
+        Levenshtein calls per *unique* current value, normalization and
+        top-k run as numpy array ops (replaces the reference's per-row
+        Python loops, model.py:1174-1225)."""
+        vals = group["value"].to_numpy(dtype=object)
+        curs = group["current_value"].to_numpy(dtype=object)
+        rids = group[self._row_id].to_numpy(dtype=object)
+        n = len(vals)
+        records = np.empty(n, dtype=object)
+
+        classes_of = [v.get("classes", []) if isinstance(v, dict) else []
+                      for v in vals]
+        nonempty = np.array([len(c) > 0 for c in classes_of], dtype=bool)
+        for i in np.nonzero(~nonempty)[0]:
+            records[i] = {
+                self._row_id: rids[i], "attribute": attr,
+                "current_value": {"value": curs[i], "prob": 0.0}, "pmf": []}
+        ne_idx = np.nonzero(nonempty)[0]
+        if len(ne_idx) == 0:
+            return records
+
+        classes = classes_of[ne_idx[0]]
+        k = len(classes)
+        if any(len(classes_of[i]) != k for i in ne_idx):
+            # distinct models for one attribute can't happen in this pipeline;
+            # defensive split so a future caller still gets correct output
+            for sub_k, sub in pd.Series(ne_idx).groupby(
+                    [len(classes_of[i]) for i in ne_idx]):
+                sub_group = group.iloc[sub.to_numpy()]
+                records[sub.to_numpy()] = self._pmf_records_for_attr(
+                    attr, sub_group, weighted, weight, threshold, top_k)
+            return records
+
+        P = np.stack([np.asarray(vals[i]["probs"], dtype=np.float64)[:k]
+                      for i in ne_idx])
+        curs_ne = curs[ne_idx]
+
+        if weighted:
+            codes, uniques = pd.factorize(pd.Series(curs_ne, dtype=object),
+                                          use_na_sentinel=True)
+            # one weight row per unique current value (batched Levenshtein),
+            # plus a trailing all-ones row that null/falsy currents (code -1)
+            # index into — those keep their unweighted probs, like the
+            # reference's `costs is None` branch
+            W = np.ones((len(uniques) + 1, k), dtype=np.float64)
+            for u, cur in enumerate(uniques):
+                costs = self.cf.compute_many(cur, classes) \
+                    if self.cf is not None else None
+                if costs is not None:
+                    W[u] = [1.0 / (1.0 + weight * c) if c is not None else 1.0
+                            for c in costs]
+            P = P * W[codes]
+            totals = P.sum(axis=1, keepdims=True)
+            np.divide(P, totals, out=P, where=totals > 0)
+
+        class_idx = {}
+        for j, c in enumerate(classes):
+            class_idx.setdefault(c, j)
+        cur_pos = np.array([class_idx.get(c, -1) for c in curs_ne])
+        cur_probs = np.where(
+            cur_pos >= 0, P[np.arange(len(ne_idx)), np.where(
+                cur_pos >= 0, cur_pos, 0)], 0.0)
+
+        kk = min(int(top_k), k)
+        order = np.argsort(-P, axis=1, kind="stable")[:, :kk]
+        top_probs = np.take_along_axis(P, order, axis=1)
+        classes_arr = np.array(classes, dtype=object)
+        top_classes = classes_arr[order]
+        counts = np.minimum((P > threshold).sum(axis=1), kk)
+
+        for r, i in enumerate(ne_idx):
+            records[i] = {
+                self._row_id: rids[i], "attribute": attr,
+                "current_value": {"value": curs[i],
+                                  "prob": float(cur_probs[r])},
+                "pmf": [{"class": top_classes[r, j],
+                         "prob": float(top_probs[r, j])}
+                        for j in range(counts[r])]}
+        return records
 
     def _compute_repair_pmf(self, repaired_rows_df: pd.DataFrame,
                             error_cells_df: pd.DataFrame,
                             continuous_columns: List[str]) -> pd.DataFrame:
         """PMF extraction + cost weighting + top-k filtering
-        (reference model.py:1174-1225)."""
+        (reference model.py:1174-1225), vectorized per attribute."""
         flat = self._flatten(repaired_rows_df)
         keys = error_cells_df[[self._row_id, "attribute", "current_value"]]
         joined = flat.merge(keys, on=[self._row_id, "attribute"], how="inner")
 
         continuous = set(continuous_columns)
-        discrete = joined[~joined["attribute"].isin(continuous)]
-        pmf_rows: List[Dict[str, Any]] = []
-        for _, row in discrete.iterrows():
-            v = row["value"]
-            classes, probs = (v.get("classes", []), v.get("probs", [])) \
-                if isinstance(v, dict) else ([], [])
-            pmf_rows.append({
-                self._row_id: row[self._row_id],
-                "attribute": row["attribute"],
-                "current_value": row["current_value"],
-                "classes": list(classes),
-                "probs": list(probs)[: len(classes)],
-            })
+        discrete = joined[~joined["attribute"].isin(continuous)] \
+            .reset_index(drop=True)
 
-        if self.cf is not None:
-            pmf_rows = self._compute_weighted_probs(pmf_rows)
+        threshold = float(self._get_option_value(*self._opt_prob_threshold))
+        top_k = int(self._get_option_value(*self._opt_prob_top_k))
+        weight = float(self._get_option_value(*self._opt_cost_weight))
+        cf_targets = set(self.cf.targets) if self.cf is not None else set()
+        if self.cf is not None and cf_targets:
+            _logger.info(f"[Repairing Phase] {self.cf} computing weighting probs...")
 
-        threshold = self._get_option_value(*self._opt_prob_threshold)
-        top_k = self._get_option_value(*self._opt_prob_top_k)
-
-        out = []
-        for rec in pmf_rows:
-            cur = rec["current_value"]
-            cur_prob = 0.0
-            for c, p in zip(rec["classes"], rec["probs"]):
-                if c == cur:
-                    cur_prob = p
-                    break
-            pmf = sorted(
-                ({"class": c, "prob": p} for c, p in zip(rec["classes"], rec["probs"])),
-                key=lambda e: -e["prob"])
-            pmf = [e for e in pmf if e["prob"] > threshold][:top_k]
-            out.append({
-                self._row_id: rec[self._row_id],
-                "attribute": rec["attribute"],
-                "current_value": {"value": cur, "prob": cur_prob},
-                "pmf": pmf,
-            })
+        records = np.empty(len(discrete), dtype=object)
+        for attr, group in discrete.groupby("attribute", sort=False):
+            weighted = self.cf is not None and \
+                (not cf_targets or attr in cf_targets)
+            idx = group.index.to_numpy()
+            records[idx] = self._pmf_records_for_attr(
+                str(attr), group, weighted, weight, threshold, top_k)
+        out = list(records)
 
         if continuous:
             cont = joined[joined["attribute"].isin(continuous)]
-            for _, row in cont.iterrows():
+            for rid, a, v, cur in zip(
+                    cont[self._row_id], cont["attribute"], cont["value"],
+                    cont["current_value"]):
                 out.append({
-                    self._row_id: row[self._row_id],
-                    "attribute": row["attribute"],
-                    "current_value": {"value": row["current_value"], "prob": 0.0},
-                    "pmf": [{"class": row["value"], "prob": 1.0}],
+                    self._row_id: rid,
+                    "attribute": a,
+                    "current_value": {"value": cur, "prob": 0.0},
+                    "pmf": [{"class": v, "prob": 1.0}],
                 })
 
         pmf_df = pd.DataFrame(
@@ -863,27 +936,37 @@ class RepairModel:
 
     def _compute_score(self, pmf_df: pd.DataFrame) -> pd.DataFrame:
         """Log-likelihood-ratio x cost-discount score (reference
-        model.py:1227-1248)."""
+        model.py:1227-1248). Vectorized: cost lookups dedupe to one
+        `cf.compute` per unique (base, repaired) pair, the score math runs
+        as numpy array ops."""
         assert self.cf is not None
-        rows = []
-        for _, row in pmf_df.iterrows():
-            pmf = row["pmf"]
-            repaired = pmf[0] if pmf else {"class": None, "prob": 1e-6}
-            cur = row["current_value"]
-            base = cur["value"] if cur["value"] is not None else repaired["class"]
-            cost = self.cf.compute(base, repaired["class"])
-            cur_prob = cur["prob"] if cur["prob"] > 0.0 else 1e-6
-            score = np.log(max(repaired["prob"], 1e-300) / cur_prob) * \
-                (1.0 / (1.0 + (cost if cost is not None else 256.0)))
-            rows.append({
-                self._row_id: row[self._row_id],
-                "attribute": row["attribute"],
-                "current_value": cur["value"],
-                "repaired": repaired["class"],
-                "score": float(score),
-            })
-        return pd.DataFrame(
-            rows, columns=[self._row_id, "attribute", "current_value", "repaired", "score"])
+        pmfs = pmf_df["pmf"].tolist()
+        curs = pmf_df["current_value"].tolist()
+        rep_class = [p[0]["class"] if p else None for p in pmfs]
+        rep_prob = np.array([p[0]["prob"] if p else 1e-6 for p in pmfs],
+                            dtype=np.float64)
+        cur_val = [c["value"] for c in curs]
+        cur_prob = np.array([c["prob"] for c in curs], dtype=np.float64)
+        base = [cv if cv is not None else rc
+                for cv, rc in zip(cur_val, rep_class)]
+
+        pair_cost: Dict[Tuple[Any, Any], Optional[float]] = {}
+        costs = np.empty(len(pmfs), dtype=np.float64)
+        for i, key in enumerate(zip(base, rep_class)):
+            if key not in pair_cost:
+                pair_cost[key] = self.cf.compute(*key)
+            c = pair_cost[key]
+            costs[i] = c if c is not None else 256.0
+
+        cur_prob = np.where(cur_prob > 0.0, cur_prob, 1e-6)
+        score = np.log(np.maximum(rep_prob, 1e-300) / cur_prob) / (1.0 + costs)
+        return pd.DataFrame({
+            self._row_id: pmf_df[self._row_id].to_numpy(),
+            "attribute": pmf_df["attribute"].to_numpy(),
+            "current_value": np.array(cur_val, dtype=object),
+            "repaired": np.array(rep_class, dtype=object),
+            "score": score.astype(float),
+        }, columns=[self._row_id, "attribute", "current_value", "repaired", "score"])
 
     def _maximal_likelihood_repair(self, score_df: pd.DataFrame,
                                    error_cells_df: pd.DataFrame) -> pd.DataFrame:
@@ -1211,6 +1294,44 @@ class RepairModel:
                 compute_repair_score, repair_data, maximal_likelihood_repair)
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
         return df
+
+
+def _flatten_value(v: Any) -> Any:
+    if v is not None and not isinstance(v, dict) and pd.isna(v):
+        return None
+    elif isinstance(v, (bool, np.bool_)):
+        return str(int(v))
+    elif isinstance(v, (int, np.integer)):
+        return str(int(v))
+    elif isinstance(v, (float, np.floating)):
+        return str(float(v))
+    elif not isinstance(v, dict) and v is not None:
+        return str(v)
+    return v
+
+
+def _flatten_column(s: pd.Series) -> np.ndarray:
+    """Stringifies one column for the long view without per-row Python work
+    where the dtype allows (str(int)/str(float) formatting preserved)."""
+    if pd.api.types.is_bool_dtype(s.dtype):
+        return s.astype("int64").astype(str).to_numpy(dtype=object)
+    if pd.api.types.is_integer_dtype(s.dtype) or pd.api.types.is_float_dtype(s.dtype):
+        na = s.isna().to_numpy()
+        out = s.astype(str).to_numpy(dtype=object)
+        out[na] = None
+        return out
+    if s.dtype == object:
+        inferred = pd.api.types.infer_dtype(s, skipna=True)
+        if inferred in ("string", "empty"):
+            arr = s.to_numpy(dtype=object).copy()
+            arr[s.isna().to_numpy()] = None
+            return arr
+        arr = s.to_numpy(dtype=object)
+        return np.array([_flatten_value(v) for v in arr], dtype=object)
+    na = s.isna().to_numpy()
+    out = s.astype(str).to_numpy(dtype=object)
+    out[na] = None
+    return out
 
 
 def _is_null(v: Any) -> bool:
