@@ -21,9 +21,10 @@ import optax
 import pandas as pd
 
 
-def _pad_rows(X: np.ndarray, *arrays: np.ndarray):
+def _pad_rows(X: np.ndarray, *arrays: np.ndarray, mesh: Any = None):
+    from delphi_tpu.parallel.mesh import padded_row_target
     n = X.shape[0]
-    padded = max(8, 1 << (n - 1).bit_length())
+    padded = padded_row_target(n, mesh)
     if padded == n:
         mask = np.ones(n, dtype=np.float32)
         return X, arrays, mask
@@ -47,8 +48,8 @@ def _pad_cols(X: np.ndarray) -> np.ndarray:
         [X, np.zeros((X.shape[0], target - d), X.dtype)], axis=1)
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
-def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps):
+@partial(jax.jit, static_argnames=("n_steps", "axis_name"))
+def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps, axis_name=None):
     n, d = X.shape
     k = class_weights.shape[0]
     W = jnp.zeros((d, k), dtype=jnp.float32)
@@ -56,24 +57,66 @@ def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps):
     opt = optax.adam(lr)
     state = opt.init((W, b))
     sample_w = mask * class_weights[y]
-    denom = jnp.maximum(sample_w.sum(), 1.0)
+    denom_local = sample_w.sum()
+    if axis_name is not None:
+        # rows sharded over dp: the weighted-row normalizer is global, the
+        # L2 term is divided by the shard count so the psum of per-device
+        # losses/grads counts it exactly once
+        denom = jnp.maximum(jax.lax.psum(denom_local, axis_name), 1.0)
+        reg_scale = 1.0 / jax.lax.psum(1.0, axis_name)
+    else:
+        denom = jnp.maximum(denom_local, 1.0)
+        reg_scale = 1.0
 
     def loss_fn(params):
         W, b = params
         logits = X @ W + b
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
-        return (sample_w * nll).sum() / denom + l2 * jnp.sum(W * W)
+        return (sample_w * nll).sum() / denom + reg_scale * l2 * jnp.sum(W * W)
 
     def step(carry, _):
         params, state = carry
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        if axis_name is not None:
+            # data-parallel allreduce keeps params identical on all devices
+            loss = jax.lax.psum(loss, axis_name)
+            grads = jax.lax.psum(grads, axis_name)
         updates, state = opt.update(grads, state)
         params = optax.apply_updates(params, updates)
         return (params, state), loss
 
     (params, _), losses = jax.lax.scan(step, ((W, b), state), None, length=n_steps)
     return params, losses[-1]
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=128)
+def _mesh_logreg_fn(mesh, l2, lr, n_steps):
+    """Cached, jitted shard_map program per (mesh, hyperparameters) so
+    repeated per-attribute fits reuse one compiled executable."""
+    from jax.sharding import PartitionSpec as P
+
+    from delphi_tpu.parallel.mesh import shard_map
+
+    def fn(X_l, y_l, m_l, cw):
+        return _fit_logreg(X_l, y_l, m_l, cw, l2, lr, n_steps, axis_name="dp")
+
+    return jax.jit(shard_map(fn, mesh=mesh,
+                             in_specs=(P("dp", None), P("dp"), P("dp"), P()),
+                             out_specs=((P(), P()), P())))
+
+
+def _mesh_fit_logreg(mesh, X, y, mask, class_weights, l2, lr, n_steps):
+    """Logistic-head training with rows sharded over the mesh's dp axis and
+    per-step psum'd gradients (reference P2, SURVEY.md §2.3)."""
+    from delphi_tpu.parallel.mesh import shard_rows
+
+    step = _mesh_logreg_fn(mesh, float(l2), float(lr), int(n_steps))
+    return step(shard_rows(X, mesh), shard_rows(y, mesh),
+                shard_rows(mask, mesh), jnp.asarray(class_weights))
 
 
 @partial(jax.jit, static_argnames=("n_steps", "hidden"))
@@ -154,11 +197,18 @@ class LogisticRegressionModel:
         class_weights[:k] = balanced_class_weights(
             counts[:k], len(codes), damped=False)
 
+        from delphi_tpu.parallel.mesh import get_active_mesh
+        mesh = get_active_mesh()
         Xp, (yp,), mask = _pad_rows(_pad_cols(np.asarray(X, np.float32)),
-                                    codes.astype(np.int32))
-        params, loss = _fit_logreg(
-            jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
-            jnp.asarray(class_weights), self.l2, self.lr, self.n_steps)
+                                    codes.astype(np.int32), mesh=mesh)
+        if mesh is not None:
+            params, loss = _mesh_fit_logreg(
+                mesh, Xp, yp, mask, class_weights, self.l2, self.lr,
+                self.n_steps)
+        else:
+            params, loss = _fit_logreg(
+                jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
+                jnp.asarray(class_weights), self.l2, self.lr, self.n_steps)
         self._params = jax.device_get(params)
         self.loss_ = float(loss)
         return self

@@ -7,6 +7,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore # noqa: F401
+
 _active_mesh_cache: dict = {}
 
 
@@ -79,3 +84,15 @@ def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
     """Places an array on the mesh sharded along axis 0."""
     spec = P(axis, *([None] * (array.ndim - 1)))
     return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+def padded_row_target(n: int, mesh: Optional[Mesh], axis: str = "dp") -> int:
+    """Row count to pad to: the next power of two (>= 8, recompilation
+    bound), raised to a multiple of the mesh's dp size so row shards are
+    equal. dp sizes that are powers of two (the normal case) leave the
+    power-of-two target unchanged."""
+    target = max(8, 1 << (max(n, 1) - 1).bit_length())
+    if mesh is not None:
+        dp = mesh.shape[axis]
+        target = ((target + dp - 1) // dp) * dp
+    return target

@@ -12,9 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
-from delphi_tpu.parallel.mesh import pad_rows_to_multiple, shard_rows
+from delphi_tpu.parallel.mesh import pad_rows_to_multiple, shard_map, shard_rows
 
 
 def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarray:

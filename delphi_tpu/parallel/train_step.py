@@ -21,7 +21,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from delphi_tpu.parallel.mesh import shard_map
 
 
 def logreg_train_step(mesh: Mesh, lr: float = 0.1, l2: float = 1e-4):
