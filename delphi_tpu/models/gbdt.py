@@ -16,7 +16,7 @@ balanced class weights like the reference's `class_weight='balanced'`
 (train.py:105), which drives its characteristic minority-class repairs.
 """
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -83,7 +83,7 @@ class _Binner:
 @partial(jax.jit, static_argnames=("depth", "n_bins", "n_nodes", "axis_name"))
 def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
                 reg_lambda, min_split_gain, min_child_weight,
-                min_child_samples, axis_name=None):
+                min_child_samples, axis_name=None, bin1h2d=None):
     """Grows one depth-wise tree. Returns (feat[int32 n_nodes-1],
     thr[int32 n_nodes-1], leaf[f32 n_nodes]) with all-left sentinel splits
     (thr = n_bins) for terminated nodes. Rows with weight 0 (padding /
@@ -98,28 +98,33 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
     thr = jnp.full(n_nodes - 1, n_bins, dtype=jnp.int32)
     node = jnp.zeros(n, dtype=jnp.int32)
 
+    # Histograms run as one-hot MATMULS, not scatter-adds: TPU scatters
+    # serialize on the VPU (measured ~100x slower here and able to crash the
+    # worker in large vmapped batches), while hist[l,f,b] =
+    # sum_n node1h[n,l] * val[n] * bin1h[n,f,b] is exactly an
+    # (4*n_level, n) @ (n, d*B) contraction the MXU eats. bin1h is
+    # loop-invariant — callers that build many trees (the boosting scan's
+    # class-tree vmap) pass it in so it materializes once, not per tree.
+    if bin1h2d is None:
+        bin1h2d = jax.nn.one_hot(bins, n_bins,
+                                 dtype=jnp.float32).reshape(n, d * n_bins)
+    vals = jnp.stack([grad, hess, weight, counts])  # (4, n)
+
     for level in range(depth):
         n_level = 1 << level
-        # histograms over (node, feature, bin)
-        flat = (node[:, None] * d + jnp.arange(d)[None, :]) * n_bins + bins
-        flat = flat.reshape(-1)
-        size = n_level * d * n_bins
-        hg = jnp.zeros(size, jnp.float32).at[flat].add(
-            jnp.repeat(grad, d)).reshape(n_level, d, n_bins)
-        hh = jnp.zeros(size, jnp.float32).at[flat].add(
-            jnp.repeat(hess, d)).reshape(n_level, d, n_bins)
-        hw = jnp.zeros(size, jnp.float32).at[flat].add(
-            jnp.repeat(weight, d)).reshape(n_level, d, n_bins)
-        hc = jnp.zeros(size, jnp.float32).at[flat].add(
-            jnp.repeat(counts, d)).reshape(n_level, d, n_bins)
+        node1h = jax.nn.one_hot(node, n_level, dtype=jnp.float32)  # (n, l)
+        weighted = vals[:, :, None] * node1h[None]  # (4, n, n_level)
+        lhs = weighted.transpose(0, 2, 1).reshape(4 * n_level, n)
+        hist = jax.lax.dot_general(
+            lhs, bin1h2d, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (4*n_level, d*B)
+        hist = hist.reshape(4, n_level, d, n_bins)
 
         if axis_name is not None:
             # rows are sharded over the mesh: local histograms reduce over
             # ICI — the TPU form of the reference's Spark shuffle (P1/P2)
-            hg = jax.lax.psum(hg, axis_name)
-            hh = jax.lax.psum(hh, axis_name)
-            hw = jax.lax.psum(hw, axis_name)
-            hc = jax.lax.psum(hc, axis_name)
+            hist = jax.lax.psum(hist, axis_name)
+        hg, hh, hw, hc = hist[0], hist[1], hist[2], hist[3]
 
         GL = jnp.cumsum(hg, axis=2)
         HL = jnp.cumsum(hh, axis=2)
@@ -156,12 +161,11 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
         go_right = bins[jnp.arange(n), best_f[node]] > best_b[node]
         node = node * 2 + go_right.astype(jnp.int32)
 
-    leaf_g = jnp.zeros(n_nodes, jnp.float32).at[node].add(grad)
-    leaf_h = jnp.zeros(n_nodes, jnp.float32).at[node].add(hess)
+    leaf1h = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)  # (n, n_nodes)
+    leaf_gh = jnp.stack([grad, hess]) @ leaf1h  # (2, n_nodes)
     if axis_name is not None:
-        leaf_g = jax.lax.psum(leaf_g, axis_name)
-        leaf_h = jax.lax.psum(leaf_h, axis_name)
-    leaf = -leaf_g / (leaf_h + reg_lambda)
+        leaf_gh = jax.lax.psum(leaf_gh, axis_name)
+    leaf = -leaf_gh[0] / (leaf_gh[1] + reg_lambda)
     return feat, thr, leaf, node
 
 
@@ -203,13 +207,16 @@ def _boost(bins, y, weight, n_rounds, depth, n_bins, n_nodes, objective, k,
         return (p - onehot) * weight[None, :], \
             jnp.maximum(p * (1 - p), 1e-6) * weight[None, :]
 
+    bin1h2d = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32) \
+        .reshape(n, bins.shape[1] * n_bins)
+
     def one_round(F, _):
         g, h = grad_hess(F)
 
         def build(gk, hk):
             return _build_tree(bins, gk, hk, weight, depth, n_bins, n_nodes,
                                reg_lambda, min_split_gain, min_child_weight,
-                               min_child_samples, axis_name)
+                               min_child_samples, axis_name, bin1h2d)
 
         feat, thr, leaf, node = jax.vmap(build)(g, h)  # [k_trees, ...]
         leaf = leaf * lr
@@ -257,9 +264,6 @@ def _predict_boosted(bins, feats, thrs, leaves, n_rounds, depth, objective, k,
 # ---------------------------------------------------------------------------
 # Multi-chip (mesh) training and inference
 # ---------------------------------------------------------------------------
-
-from functools import lru_cache  # noqa: E402  (module section marker above)
-
 
 @lru_cache(maxsize=128)
 def _mesh_boost_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k,
@@ -334,32 +338,48 @@ def _mesh_predict(mesh, bins, feats, thrs, leaves, n_rounds, depth,
 # Batched cross-validation grid search
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
-                                   "objective", "k"))
-def _boost_and_score_batch(bins, y, weights, n_rounds, depth, n_bins, n_nodes,
-                           objective, k, lrs, reg_lambdas, min_split_gains,
-                           min_child_weights, bases):
-    """Trains one boosted model per (config, fold) instance — each instance
-    carries its own bin tensor, targets, per-row weights and scalar
-    hyperparameters — then scores every instance on the full row set in one
-    vmapped program. The sequential hyperopt×CV loop of the reference
-    (train.py:163-209) becomes a single XLA launch."""
+@lru_cache(maxsize=128)
+def _cv_fold_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k):
+    """One CV launch: all configs of a (depth, rounds) group train against
+    ONE fold's shared bin/target/weight tensors, vmapped over the scalar
+    hyperparameters only. Sharing the fold tensors lets XLA emit
+    shared-rhs batched matmuls for the histogram contractions (one bin1h
+    read serves every config) instead of per-instance reads. Under a mesh,
+    rows shard over dp with psum'd histograms (reference P2, the pandas-UDF
+    training fan-out, train.py:163-209 / model.py:817-926)."""
+    axis_name = "dp" if mesh is not None else None
 
-    def one(bins_i, y_i, weight, lr, reg_lambda, min_split_gain,
-            min_child_weight, base):
-        trees = _boost(bins_i, y_i, weight, n_rounds, depth, n_bins, n_nodes,
-                       objective, k, lr, reg_lambda, min_split_gain,
-                       min_child_weight, base, 0.0)
-        return _predict_boosted(bins_i, *trees, n_rounds, depth, objective, k,
-                                base)
+    def fn(bins, y_, weight, lrs, reg_lambdas, min_split_gains,
+           min_child_weights, base):
+        def one(lr, reg_lambda, min_split_gain, min_child_weight):
+            trees = _boost(bins, y_, weight, n_rounds, depth, n_bins,
+                           n_nodes, objective, k, lr, reg_lambda,
+                           min_split_gain, min_child_weight, base, 0.0,
+                           axis_name=axis_name)
+            return _predict_boosted(bins, *trees, n_rounds, depth, objective,
+                                    k, base, axis_name=axis_name)
 
-    return jax.vmap(one)(bins, y, weights, lrs, reg_lambdas, min_split_gains,
-                         min_child_weights, bases)
+        return jax.vmap(one)(lrs, reg_lambdas, min_split_gains,
+                             min_child_weights)
+
+    if mesh is None:
+        return jax.jit(fn)
+
+    from jax.sharding import PartitionSpec as P
+
+    from delphi_tpu.parallel.mesh import shard_map
+
+    out_spec = P(None, None, "dp") if objective == "multiclass" \
+        else P(None, "dp")
+    return jax.jit(shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("dp", None), P("dp"), P("dp"), P(), P(), P(), P(), P()),
+        out_specs=out_spec))
 
 
 def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
-                        num_class: int, configs: List[dict], n_splits: int,
-                        max_bin: int, class_weight: str,
+                        configs: List[dict], n_splits: int,
+                        class_weight: str,
                         template: "GradientBoostedTreesModel") -> Tuple[int, float]:
     """K-fold CV over a hyperparameter grid in one batched device launch per
     static-shape group (configs sharing tree depth and round count vmap
@@ -411,6 +431,9 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
     folds = np.array_split(order, max(2, min(n_splits, n)))
     folds = [f for f in folds if len(f)]
 
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+
     # Per-fold preprocessing matches a standalone fit on the fold's training
     # rows exactly: bin edges (and, for regression, the log-target decision)
     # come from the training rows only; all rows are then transformed with
@@ -421,9 +444,9 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
         train_mask[fold] = False
         binner_f = _Binner(template.max_bin).fit(Xm[train_mask])
         fold_bins.append(template._pad(template._pad_feature_dim(
-            binner_f.transform(Xm))))
+            binner_f.transform(Xm)), mesh=mesh))
         if is_discrete:
-            fold_y.append(template._pad(yv))
+            fold_y.append(template._pad(yv, mesh=mesh))
             fold_log.append(False)
         else:
             ytr = yv64[train_mask]
@@ -432,7 +455,7 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                 if std > 0 else 0.0
             log_f = bool((ytr >= 0).all() and skew > 2.0)
             yv_f = (np.log1p(yv64) if log_f else yv64).astype(np.float32)
-            fold_y.append(template._pad(yv_f))
+            fold_y.append(template._pad(yv_f, mesh=mesh))
             fold_log.append(log_f)
 
     # Configs sharing (depth, rounds) vmap into one launch; configs that
@@ -445,90 +468,97 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
     for ci, cfg in enumerate(configs):
         groups.setdefault((cfg_depth(cfg), cfg_rounds(cfg)), []).append(ci)
 
+    # Deploy-parity scoring (see _recalibrate): balanced training weights are
+    # importance-corrected back to the true priors before the argmax, exactly
+    # as predict_proba does, so CV ranks configs by deployed behavior.
+    if is_discrete and class_weight == "balanced":
+        from delphi_tpu.models.encoding import balanced_class_weights
+        per_class_w = balanced_class_weights(counts, len(codes))
+    else:
+        per_class_w = None
+
+    # Per-fold tensors (weights, base scores, device placement) are group-
+    # independent: prepare and place them once, then reuse across groups.
+    fold_prep = []
+    for fi, fold in enumerate(folds):
+        train_mask = np.ones(n, dtype=bool)
+        train_mask[fold] = False
+        if is_discrete and len(np.unique(yv[train_mask])) < 2:
+            continue
+        w = np.where(train_mask, w_full, 0.0).astype(np.float32)
+        yv_f = fold_y[fi][:n]
+        if objective == "binary":
+            pos = float((w * yv_f).sum() / max(w.sum(), 1e-9))
+            pos = min(max(pos, 1e-6), 1 - 1e-6)
+            base = np.array([np.log(pos / (1 - pos))], dtype=np.float32)
+        elif objective == "multiclass":
+            priors = np.zeros(k)
+            np.add.at(priors, yv_f.astype(np.int64), w)
+            priors = np.maximum(priors / max(priors.sum(), 1e-9), 1e-13)
+            base = np.log(priors).astype(np.float32)
+        else:
+            base = np.array(
+                [float((w * yv_f).sum() / max(w.sum(), 1e-9))], np.float32)
+
+        bins_dev: Any = fold_bins[fi]
+        y_dev: Any = fold_y[fi]
+        w_dev: Any = template._pad(w, mesh=mesh)
+        if mesh is not None:
+            from delphi_tpu.parallel.mesh import shard_rows
+            bins_dev = shard_rows(bins_dev, mesh)
+            y_dev = shard_rows(y_dev, mesh)
+            w_dev = shard_rows(w_dev, mesh)
+        else:
+            bins_dev = jnp.asarray(bins_dev)
+            y_dev = jnp.asarray(y_dev)
+            w_dev = jnp.asarray(w_dev)
+        fold_prep.append((fi, fold, bins_dev, y_dev, w_dev,
+                          jnp.asarray(base)))
+
     per_config: Dict[int, List[float]] = {}
     for (g_depth, g_rounds), cfg_indices in groups.items():
-        binss, ys, weights, lrs, regs, msgs, mcws, bases, metas = \
-            [], [], [], [], [], [], [], [], []
-        for ci in cfg_indices:
-            cfg = configs[ci]
-            for fi, fold in enumerate(folds):
-                train_mask = np.ones(n, dtype=bool)
-                train_mask[fold] = False
-                if is_discrete and len(np.unique(yv[train_mask])) < 2:
-                    continue
-                w = np.where(train_mask, w_full, 0.0).astype(np.float32)
-                yv_f = fold_y[fi][:n]
-                if objective == "binary":
-                    pos = float((w * yv_f).sum() / max(w.sum(), 1e-9))
-                    pos = min(max(pos, 1e-6), 1 - 1e-6)
-                    base = np.array([np.log(pos / (1 - pos))], dtype=np.float32)
-                elif objective == "multiclass":
-                    priors = np.zeros(k)
-                    np.add.at(priors, yv_f.astype(np.int64), w)
-                    priors = np.maximum(priors / max(priors.sum(), 1e-9), 1e-13)
-                    base = np.log(priors).astype(np.float32)
+        lrs = np.asarray([configs[ci].get("learning_rate", 0.1)
+                          for ci in cfg_indices], np.float32)
+        regs = np.asarray([configs[ci].get("reg_lambda", 1.0)
+                           for ci in cfg_indices], np.float32)
+        msgs = np.asarray([template.min_split_gain] * len(cfg_indices),
+                          np.float32)
+        mcws = np.asarray([configs[ci].get("min_child_weight", 1.0)
+                           for ci in cfg_indices], np.float32)
+        fn = _cv_fold_fn(mesh, g_rounds, g_depth, n_bins, 1 << g_depth,
+                         objective, k)
+
+        for fi, fold, bins_dev, y_dev, w_dev, base_dev in fold_prep:
+            F = fn(bins_dev, y_dev, w_dev, jnp.asarray(lrs),
+                   jnp.asarray(regs), jnp.asarray(msgs), jnp.asarray(mcws),
+                   base_dev)
+            F = np.asarray(jax.device_get(F))[..., :n]  # [n_cfg, (k,) n]
+
+            for j, ci in enumerate(cfg_indices):
+                if is_discrete:
+                    if objective == "multiclass":
+                        z = F[j][:k_real, fold]
+                        z = z - z.max(axis=0, keepdims=True)
+                        probs = np.exp(z)
+                        probs /= np.maximum(probs.sum(axis=0, keepdims=True),
+                                            1e-12)
+                    else:
+                        p = 1.0 / (1.0 + np.exp(-F[j][fold]))
+                        probs = np.stack([1 - p, p])[:k_real]
+                    if per_class_w is not None:
+                        probs = probs / np.maximum(
+                            per_class_w[:probs.shape[0], None], 1e-12)
+                    pred_codes = probs.argmax(axis=0)
+                    truth = y_arr[fold].astype(str)
+                    pred = classes[np.minimum(pred_codes,
+                                              k_real - 1)].astype(str)
+                    score = f1_macro(truth, pred)
                 else:
-                    base = np.array(
-                        [float((w * yv_f).sum() / max(w.sum(), 1e-9))], np.float32)
-                binss.append(fold_bins[fi])
-                ys.append(fold_y[fi])
-                weights.append(template._pad(w))
-                lrs.append(cfg.get("learning_rate", 0.1))
-                regs.append(cfg.get("reg_lambda", 1.0))
-                msgs.append(template.min_split_gain)
-                mcws.append(cfg.get("min_child_weight", 1.0))
-                bases.append(base)
-                metas.append((ci, fi, fold))
-
-        if not metas:
-            continue
-
-        batch = [np.stack(binss), np.stack(ys), np.stack(weights),
-                 np.asarray(lrs, np.float32), np.asarray(regs, np.float32),
-                 np.asarray(msgs, np.float32), np.asarray(mcws, np.float32),
-                 np.stack(bases)]
-        from delphi_tpu.parallel.mesh import get_active_mesh
-        mesh = get_active_mesh()
-        if mesh is not None:
-            # Parallel model training over the mesh (reference P2, the
-            # pandas-UDF fan-out model.py:817-926): the (config x fold)
-            # instances are embarrassingly parallel, so sharding the batch
-            # axis over dp trains them on different devices. The batch pads
-            # to a multiple of dp by repeating the last instance; the
-            # padded copies' scores are never read (metas is unpadded).
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            dp = mesh.shape["dp"]
-            B = batch[0].shape[0]
-            target = ((B + dp - 1) // dp) * dp
-            if target != B:
-                batch = [np.concatenate(
-                    [a, np.repeat(a[-1:], target - B, axis=0)], axis=0)
-                    for a in batch]
-            batch = [jax.device_put(a, NamedSharding(
-                mesh, P("dp", *([None] * (a.ndim - 1))))) for a in batch]
-        else:
-            batch = [jnp.asarray(a) for a in batch]
-        F = _boost_and_score_batch(
-            batch[0], batch[1], batch[2], g_rounds, g_depth, n_bins,
-            1 << g_depth, objective, k, batch[3], batch[4], batch[5],
-            batch[6], batch[7])
-        F = np.asarray(jax.device_get(F))[..., :n]  # [B, (k,) n]
-
-        for b, (ci, fi, fold) in enumerate(metas):
-            if objective == "multiclass":
-                pred_codes = F[b][:k_real].argmax(axis=0)[fold]
-            elif objective == "binary":
-                pred_codes = (F[b][fold] > 0).astype(np.int64)
-            if is_discrete:
-                truth = y_arr[fold].astype(str)
-                pred = classes[np.minimum(pred_codes, k_real - 1)].astype(str)
-                score = f1_macro(truth, pred)
-            else:
-                pred = F[b][fold]
-                if fold_log[fi]:
-                    pred = np.expm1(pred)
-                score = -float(((pred - yv64[fold]) ** 2).mean())
-            per_config.setdefault(ci, []).append(score)
+                    pred = F[j][fold]
+                    if fold_log[fi]:
+                        pred = np.expm1(pred)
+                    score = -float(((pred - yv64[fold]) ** 2).mean())
+                per_config.setdefault(ci, []).append(score)
 
     if not per_config:
         return 0, -np.inf

@@ -11,7 +11,7 @@ They expose the scikit-learn-like duck type (``classes_`` / ``predict`` /
 model.py:44-100, train.py:232-234).
 """
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Optional
 
 import jax
@@ -88,9 +88,6 @@ def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps, axis_name=None):
 
     (params, _), losses = jax.lax.scan(step, ((W, b), state), None, length=n_steps)
     return params, losses[-1]
-
-
-from functools import lru_cache
 
 
 @lru_cache(maxsize=128)

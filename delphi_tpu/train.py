@@ -160,8 +160,7 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 # launch instead of the reference's sequential hyperopt loop
                 template = factory(grid[0])()
                 best_ci, best_score = gbdt_cv_grid_search(
-                    X, y, is_discrete, num_class, grid, n_splits,
-                    int(opt(*_opt_max_bin)), class_weight, template)
+                    X, y, is_discrete, grid, n_splits, class_weight, template)
                 best_cfg = grid[best_ci]
             model = factory(best_cfg)()
             model.fit(X, y)
