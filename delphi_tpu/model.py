@@ -65,7 +65,9 @@ class FunctionalDepModel:
 
     def __init__(self, x: str, fd_map: Dict[str, str]) -> None:
         self.fd_map = fd_map
-        self.classes = list(set(fd_map.values()))
+        # sorted: str-set iteration order varies with hash randomization,
+        # which would make classes_ (and PMF tie-breaking) vary across runs
+        self.classes = sorted(set(fd_map.values()))
         self.x = x
         self.fd_keypos_map = {c: i for i, c in enumerate(self.classes)}
 

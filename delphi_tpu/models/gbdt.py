@@ -115,9 +115,13 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
         node1h = jax.nn.one_hot(node, n_level, dtype=jnp.float32)  # (n, l)
         weighted = vals[:, :, None] * node1h[None]  # (4, n, n_level)
         lhs = weighted.transpose(0, 2, 1).reshape(4 * n_level, n)
+        # HIGHEST precision: the TPU's default matmul mode rounds f32
+        # operands to bf16, which perturbs split gains enough to flip
+        # near-tie argmaxes vs the exact-sum semantics
         hist = jax.lax.dot_general(
             lhs, bin1h2d, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (4*n_level, d*B)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)  # (4*n_level, d*B)
         hist = hist.reshape(4, n_level, d, n_bins)
 
         if axis_name is not None:
@@ -162,7 +166,8 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
         node = node * 2 + go_right.astype(jnp.int32)
 
     leaf1h = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)  # (n, n_nodes)
-    leaf_gh = jnp.stack([grad, hess]) @ leaf1h  # (2, n_nodes)
+    leaf_gh = jnp.matmul(jnp.stack([grad, hess]), leaf1h,
+                         precision=jax.lax.Precision.HIGHEST)  # (2, n_nodes)
     if axis_name is not None:
         leaf_gh = jax.lax.psum(leaf_gh, axis_name)
     leaf = -leaf_gh[0] / (leaf_gh[1] + reg_lambda)
@@ -396,13 +401,15 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
     n_bins = template.max_bin + 1
 
     y_arr = np.asarray(y)
+    per_class_w = None
     if is_discrete:
         codes, classes = pd.factorize(y_arr, sort=True)
         k_real = len(classes)
         counts = np.bincount(codes, minlength=k_real).astype(np.float64)
         if class_weight == "balanced":
             from delphi_tpu.models.encoding import balanced_class_weights
-            w_full = balanced_class_weights(counts, len(codes))[codes]
+            per_class_w = balanced_class_weights(counts, len(codes))
+            w_full = per_class_w[codes]
         else:
             w_full = np.ones(n)
         if k_real <= 2:
@@ -468,14 +475,10 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
     for ci, cfg in enumerate(configs):
         groups.setdefault((cfg_depth(cfg), cfg_rounds(cfg)), []).append(ci)
 
-    # Deploy-parity scoring (see _recalibrate): balanced training weights are
-    # importance-corrected back to the true priors before the argmax, exactly
-    # as predict_proba does, so CV ranks configs by deployed behavior.
-    if is_discrete and class_weight == "balanced":
-        from delphi_tpu.models.encoding import balanced_class_weights
-        per_class_w = balanced_class_weights(counts, len(codes))
-    else:
-        per_class_w = None
+    # Deploy-parity scoring uses per_class_w (computed with w_full above, see
+    # _recalibrate): balanced training weights are importance-corrected back
+    # to the true priors before the argmax, exactly as predict_proba does, so
+    # CV ranks configs by deployed behavior.
 
     # Per-fold tensors (weights, base scores, device placement) are group-
     # independent: prepare and place them once, then reuse across groups.

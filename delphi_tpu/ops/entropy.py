@@ -125,6 +125,18 @@ def select_candidate_pairs(
     keep pairs whose #distinct(x,y) / (|x|*|y|) is below the threshold, sorted
     ascending, truncated to the cap.
 
+    Deliberate deviation: remaining slots fill with NEAR-FUNCTIONAL pairs —
+    #distinct(x,y) close to max(|x|,|y|), i.e. the larger-domain attribute
+    (almost) determines the other. The reference's ratio criterion is
+    mathematically unable to keep any pair for a low-cardinality target
+    (ratio >= 1/min(|x|,|y|): e.g. hospital's yes/no EmergencyService bottoms
+    out at 1/3 > 0.05), which leaves such targets without correlates, hence
+    without cell domains, hence beyond the weak-labeling demotion — their
+    clean cells stay "errors" and get mis-repaired. Near-functional partners
+    are exactly the evidence the naive-Bayes domain analysis needs there.
+    (The reference's own perf suite works around this by raising the
+    threshold to 1.0, test_model_perf.py:205.)
+
     ``freq_for_pruning`` must expose ``distinct_pair_count(x, y)``.
     """
     out: List[Pair] = []
@@ -134,11 +146,21 @@ def select_candidate_pairs(
             scored = []
             for (cx, cy) in candidates:
                 co = freq_for_pruning.distinct_pair_count(cx, cy)
-                ratio = co / (int(domain_stats[cx]) * int(domain_stats[cy]))
-                scored.append((ratio, (cx, cy)))
-            scored = [s for s in scored if s[0] < pairwise_freq_ratio_threshold]
-            scored.sort(key=lambda t: t[0])
-            out.extend(p for _, p in scored[:max_attrs_to_compute_pairwise_stats])
+                dx, dy = int(domain_stats[cx]), int(domain_stats[cy])
+                ratio = co / (dx * dy)
+                near_fd = co / max(dx, dy)  # 1.0 == exactly functional
+                scored.append((ratio, near_fd, (cx, cy)))
+            kept = [s for s in scored if s[0] < pairwise_freq_ratio_threshold]
+            kept.sort(key=lambda t: t[0])
+            kept = kept[:max_attrs_to_compute_pairwise_stats]
+            if len(kept) < max_attrs_to_compute_pairwise_stats:
+                chosen = {s[2] for s in kept}
+                extras = [s for s in scored
+                          if s[2] not in chosen and s[1] <= 1.5]
+                extras.sort(key=lambda t: t[1])
+                kept.extend(
+                    extras[:max_attrs_to_compute_pairwise_stats - len(kept)])
+            out.extend(p for _, _, p in kept)
         else:
             out.extend(candidates)
     return out
