@@ -185,16 +185,22 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     # Join keys: left rows keyed by left-attr codes, right rows by right-attr
     # codes, in shared dictionaries (null-safe: NULL code is a key value).
     if eq:
-        k1_cols, k2_cols = [], []
+        # Iterative hash-factorization of the composite join key: O(n) per
+        # key column instead of np.unique(axis=0)'s O(n log n) lexicographic
+        # sort of the full 2D key block — the difference between this and a
+        # stall on million-row tables.
+        import pandas as pd
+        inv: Optional[np.ndarray] = None
         for p in eq:
             assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
             c1, c2 = _shared_codes(table, p.left.name, p.right.name)
-            k1_cols.append(c1)
-            k2_cols.append(c2)
-        k1 = np.stack(k1_cols, axis=1)
-        k2 = np.stack(k2_cols, axis=1)
-        both = np.concatenate([k1, k2], axis=0)
-        _, inv = np.unique(both, axis=0, return_inverse=True)
+            both = np.concatenate([c1, c2]).astype(np.int64) + 1  # NULL -> 0
+            if inv is None:
+                inv = pd.factorize(both)[0]
+            else:
+                stride = int(both.max(initial=-1)) + 2
+                inv = pd.factorize(inv.astype(np.int64) * stride + both)[0]
+        assert inv is not None
         g1, g2 = inv[:n], inv[n:]
         n_groups = int(inv.max()) + 1 if inv.size else 0
     else:
@@ -213,10 +219,14 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
             a1, a2 = _shared_codes(table, p.left.name, p.right.name)
             # r1 violates iff its group holds a right-value different from
             # r1's left-value (null-safe inequality counts NULL vs value).
-            pairs = np.unique(np.stack([g2, a2], axis=1), axis=0)
-            distinct = np.bincount(pairs[:, 0], minlength=n_groups)
+            # Fused 1-D key instead of np.unique(axis=0) over a 2D stack.
+            stride = int(a2.max()) + 2 if a2.size else 1
+            fused = np.unique(g2.astype(np.int64) * stride + (a2 + 1))
+            pair_g = fused // stride
+            pair_a = fused % stride - 1
+            distinct = np.bincount(pair_g, minlength=n_groups)
             single = np.zeros(n_groups, dtype=np.int64)
-            single[pairs[:, 0]] = pairs[:, 1]  # only read where distinct == 1
+            single[pair_g] = pair_a  # only read where distinct == 1
             d1 = distinct[g1]
             return (d1 >= 2) | ((d1 == 1) & (single[g1] != a1))
         if p.sign in ("LT", "GT"):
@@ -250,25 +260,36 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         group_members[int(sg[start])] = order2[start:end]
         start = end
 
-    def pred_holds(p: Predicate, i: int, j: int) -> bool:
+    # Hoist every per-attribute array out of the pair loop: shared-dictionary
+    # codes answer EQ/IQ, comparison ranks answer LT/GT — one build per
+    # predicate instead of one per candidate pair.
+    pred_arrays = []
+    for p in rest:
         assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
-        lc = table.value_string(p.left.name, i)
-        rc = table.value_string(p.right.name, j)
-        if p.sign == "EQ":
-            return lc == rc
-        if p.sign == "IQ":
-            return lc != rc
-        lv = _comparable_values(table, p.left.name)[i]
-        rv = _comparable_values(table, p.right.name)[j]
+        if p.sign in ("EQ", "IQ"):
+            lc, rc = _shared_codes(table, p.left.name, p.right.name)
+            pred_arrays.append((p.sign, lc, rc))
+        else:
+            lv = _comparable_values(table, p.left.name)
+            rv = _comparable_values(table, p.right.name)
+            pred_arrays.append((p.sign, lv, rv))
+
+    def pred_holds(sign: str, left: np.ndarray, right: np.ndarray,
+                   i: int, j: int) -> bool:
+        if sign == "EQ":
+            return bool(left[i] == right[j])
+        if sign == "IQ":
+            return bool(left[i] != right[j])
+        lv, rv = left[i], right[j]
         if np.isnan(lv) or np.isnan(rv):
             return False
-        return lv < rv if p.sign == "LT" else lv > rv
+        return bool(lv < rv) if sign == "LT" else bool(lv > rv)
 
     out = np.zeros(n, dtype=bool)
     for i in range(n):
         members = group_members.get(int(g1[i]), np.empty(0, dtype=np.int64))
         for j in members:
-            if all(pred_holds(p, i, int(j)) for p in rest):
+            if all(pred_holds(s, lo, ro, i, int(j)) for s, lo, ro in pred_arrays):
                 out[i] = True
                 break
     return out

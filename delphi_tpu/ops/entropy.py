@@ -139,6 +139,11 @@ def select_candidate_pairs(
 
     ``freq_for_pruning`` must expose ``distinct_pair_count(x, y)``.
     """
+    if hasattr(freq_for_pruning, "warm"):
+        freq_for_pruning.warm(
+            (x, y) for x in attrs_to_repair for y in all_attrs
+            if y != x and len(all_attrs) - 1 > max_attrs_to_compute_pairwise_stats)
+
     out: List[Pair] = []
     for x in attrs_to_repair:
         candidates = [(x, y) for y in all_attrs if y != x]
@@ -155,9 +160,20 @@ def select_candidate_pairs(
             kept = kept[:max_attrs_to_compute_pairwise_stats]
             if len(kept) < max_attrs_to_compute_pairwise_stats:
                 chosen = {s[2] for s in kept}
-                extras = [s for s in scored
-                          if s[2] not in chosen and s[1] <= 1.5]
-                extras.sort(key=lambda t: t[1])
+                # Exclude key-like partners (domain ~ row count): they score
+                # a perfect near_fd of 1.0 trivially, but their pair counts
+                # are singletons that never clear the tau threshold — wasted
+                # slots carrying no generalizable evidence.
+                n_rows = getattr(freq_for_pruning, "n_rows", None)
+                extras = []
+                for s in scored:
+                    if s[2] in chosen or s[1] > 1.5:
+                        continue
+                    _, cy2 = s[2]
+                    if n_rows and int(domain_stats[cy2]) >= 0.8 * n_rows:
+                        continue
+                    extras.append(s)
+                extras.sort(key=lambda t: (t[1], int(domain_stats[t[2][1]])))
                 kept.extend(
                     extras[:max_attrs_to_compute_pairwise_stats - len(kept)])
             out.extend(p for _, _, p in kept)
