@@ -216,6 +216,12 @@ class GaussianOutlierErrorDetector(ErrorDetector):
 
     def __init__(self, approx_enabled: bool = False) -> None:
         ErrorDetector.__init__(self)
+        if approx_enabled:
+            _logger.info(
+                "GaussianOutlierErrorDetector(approx_enabled=True): the "
+                "device kernel always computes exact percentiles (cheaper "
+                "than the reference's approx path), so this flag changes "
+                "nothing — accepted for API parity")
         self.approx_enabled = approx_enabled
 
     def __str__(self) -> str:
@@ -238,6 +244,12 @@ class ScikitLearnBasedErrorDetector(ErrorDetector):
         ErrorDetector.__init__(self)
         if num_parallelism is not None and int(num_parallelism) <= 0:
             raise ValueError(f"`num_parallelism` must be positive, got {num_parallelism}")
+        if num_parallelism is not None:
+            _logger.info(
+                "ScikitLearnBasedErrorDetector: num_parallelism/"
+                "parallel_mode_threshold tune the reference's pandas-UDF "
+                "fan-out; columns run locally here, so they change nothing "
+                "— accepted for API parity")
         self.parallel_mode_threshold = parallel_mode_threshold
         self.num_parallelism = num_parallelism
 

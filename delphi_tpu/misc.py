@@ -135,9 +135,10 @@ class RepairMisc:
             raise AnalysisException(
                 f"Columns '{', '.join(unknown)}' do not exist in '{self.opts['table_name']}'")
 
-        from delphi_tpu.ops.cluster import qgram_features, kmeans
+        from delphi_tpu.ops.cluster import bisecting_kmeans, kmeans, qgram_features
         feats = qgram_features(df[target_attrs], q)
-        labels = kmeans(feats, int(self.opts["k"]), seed=0)
+        cluster = bisecting_kmeans if alg == "bisect-kmeans" else kmeans
+        labels = cluster(feats, int(self.opts["k"]), seed=0)
         return pd.DataFrame({row_id: df[row_id], "k": labels})
 
     def injectNull(self) -> pd.DataFrame:

@@ -276,6 +276,13 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setParallelStatTrainingEnabled(self, enabled: bool) -> "RepairModel":
+        if enabled:
+            _logger.info(
+                "setParallelStatTrainingEnabled: per-attribute training "
+                "already runs as batched device launches (and shards over "
+                "the mesh when DELPHI_MESH is set), so this flag selects the "
+                "same path as the default — accepted for API parity with the "
+                "reference's pandas-UDF fan-out (model.py:383-395)")
         self.parallel_stat_training_enabled = enabled
         return self
 

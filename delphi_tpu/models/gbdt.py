@@ -385,7 +385,8 @@ def _cv_fold_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k):
 def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                         configs: List[dict], n_splits: int,
                         class_weight: str,
-                        template: "GradientBoostedTreesModel") -> Tuple[int, float]:
+                        template: "GradientBoostedTreesModel",
+                        timeout_s: float = 0.0) -> Tuple[int, float]:
     """K-fold CV over a hyperparameter grid in one batched device launch per
     static-shape group (configs sharing tree depth and round count vmap
     together; others get their own launch).
@@ -395,7 +396,12 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
     (the scorers the reference feeds hyperopt, train.py:158). Each fold bins
     (and, for regression, log-transforms) from its training rows only, so an
     instance's scores match a standalone per-fold fit.
+
+    ``timeout_s`` > 0 bounds the search like the reference's hyperopt
+    timeout (train.py:196): once exceeded, the best config so far wins.
     """
+    import time
+    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     Xm = template._as_matrix(X)
     n = Xm.shape[0]
     n_bins = template.max_bin + 1
@@ -519,7 +525,10 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                           jnp.asarray(base)))
 
     per_config: Dict[int, List[float]] = {}
+    timed_out = False
     for (g_depth, g_rounds), cfg_indices in groups.items():
+        if timed_out:
+            break
         lrs = np.asarray([configs[ci].get("learning_rate", 0.1)
                           for ci in cfg_indices], np.float32)
         regs = np.asarray([configs[ci].get("reg_lambda", 1.0)
@@ -532,6 +541,9 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                          objective, k)
 
         for fi, fold, bins_dev, y_dev, w_dev, base_dev in fold_prep:
+            if deadline is not None and time.monotonic() > deadline:
+                timed_out = True
+                break
             F = fn(bins_dev, y_dev, w_dev, jnp.asarray(lrs),
                    jnp.asarray(regs), jnp.asarray(msgs), jnp.asarray(mcws),
                    base_dev)
@@ -565,6 +577,13 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
 
     if not per_config:
         return 0, -np.inf
+    if timed_out:
+        # a timeout mid-group leaves some configs scored on fewer folds; a
+        # lucky partial mean must not beat a full-CV mean (the reference's
+        # hyperopt timeout likewise only counts finished trials)
+        max_folds = max(len(s) for s in per_config.values())
+        per_config = {ci: s for ci, s in per_config.items()
+                      if len(s) == max_folds}
     mean_scores = {ci: float(np.mean(s)) for ci, s in per_config.items()}
     best_ci = max(mean_scores, key=lambda ci: mean_scores[ci])
     return best_ci, mean_scores[best_ci]

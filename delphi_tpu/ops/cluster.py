@@ -76,11 +76,15 @@ def qgram_features(df: pd.DataFrame, q: int) -> np.ndarray:
 
 
 @partial(jax.jit, static_argnames=("k", "n_iters"))
-def _kmeans_jax(X: jnp.ndarray, init: jnp.ndarray, k: int, n_iters: int) -> jnp.ndarray:
+def _kmeans_jax(X: jnp.ndarray, mask: jnp.ndarray, init: jnp.ndarray, k: int,
+                n_iters: int) -> jnp.ndarray:
+    """Masked Lloyd's iterations: rows with mask 0 (shape padding) take part
+    in distance/label computation but never pull centroids — subclusters of
+    any size can pad to a bucketed row count and share compiled programs."""
     def step(centers, _):
         d = ((X[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
         labels = d.argmin(axis=1)
-        one_hot = jax.nn.one_hot(labels, k, dtype=X.dtype)
+        one_hot = jax.nn.one_hot(labels, k, dtype=X.dtype) * mask[:, None]
         counts = one_hot.sum(0)
         sums = one_hot.T @ X
         new_centers = jnp.where(counts[:, None] > 0,
@@ -108,5 +112,42 @@ def kmeans(X: np.ndarray, k: int, seed: int = 0, n_iters: int = 20) -> np.ndarra
         else:
             centers.append(X[rng.choice(n, p=d / total)])
     init = jnp.asarray(np.stack(centers))
-    labels = _kmeans_jax(jnp.asarray(X), init, k, n_iters)
-    return np.asarray(labels, dtype=np.int64)
+    # pad rows to the next power of two so subcluster splits of varying
+    # sizes reuse one compiled program per (bucket, k)
+    target = max(8, 1 << (n - 1).bit_length())
+    Xp = X if target == n else np.concatenate(
+        [X, np.zeros((target - n,) + X.shape[1:], X.dtype)], axis=0)
+    mask = np.concatenate(
+        [np.ones(n, X.dtype), np.zeros(target - n, X.dtype)])
+    labels = _kmeans_jax(jnp.asarray(Xp), jnp.asarray(mask), init, k, n_iters)
+    return np.asarray(labels, dtype=np.int64)[:n]
+
+
+def bisecting_kmeans(X: np.ndarray, k: int, seed: int = 0,
+                     n_iters: int = 20) -> np.ndarray:
+    """Top-down divisive clustering (Spark MLlib's BisectingKMeans,
+    RepairMiscApi.scala:104-152): start from one cluster and repeatedly
+    2-means-split the largest remaining cluster until ``k`` clusters exist.
+    Each binary split runs the jitted Lloyd's kernel on the cluster's rows."""
+    n = X.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    k = min(k, n)
+    labels = np.zeros(n, dtype=np.int64)
+    next_label = 1
+    while next_label < k:
+        sizes = np.bincount(labels, minlength=next_label)
+        splittable = np.nonzero(sizes >= 2)[0]
+        if splittable.size == 0:
+            break
+        target = splittable[np.argmax(sizes[splittable])]
+        idx = np.nonzero(labels == target)[0]
+        sub = kmeans(X[idx], 2, seed=seed + next_label, n_iters=n_iters)
+        if (sub == 1).any() and (sub == 0).any():
+            labels[idx[sub == 1]] = next_label
+        else:
+            # degenerate split (identical rows): peel one row off so the
+            # cluster count still advances, like MLlib's forced division
+            labels[idx[-1]] = next_label
+        next_label += 1
+    return labels

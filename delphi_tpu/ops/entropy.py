@@ -139,14 +139,17 @@ def select_candidate_pairs(
 
     ``freq_for_pruning`` must expose ``distinct_pair_count(x, y)``.
     """
+    all_candidates = {x: [(x, y) for y in all_attrs if y != x]
+                      for x in attrs_to_repair}
     if hasattr(freq_for_pruning, "warm"):
         freq_for_pruning.warm(
-            (x, y) for x in attrs_to_repair for y in all_attrs
-            if y != x and len(all_attrs) - 1 > max_attrs_to_compute_pairwise_stats)
+            p for cands in all_candidates.values()
+            if len(cands) > max_attrs_to_compute_pairwise_stats
+            for p in cands)
 
     out: List[Pair] = []
     for x in attrs_to_repair:
-        candidates = [(x, y) for y in all_attrs if y != x]
+        candidates = all_candidates[x]
         if len(candidates) > max_attrs_to_compute_pairwise_stats:
             scored = []
             for (cx, cy) in candidates:

@@ -154,13 +154,21 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
             if is_discrete and num_class > 8:
                 # wide multiclass: CV grid search is too costly for the gain
                 grid = grid[:1]
+            if _opt_no_progress_loss.key in opts:
+                _logger.info(
+                    "`model.hp.no_progress_loss` has no effect here: the "
+                    "batched CV evaluates the whole (max_evals-bounded) grid "
+                    "in one launch per shape group instead of a sequential "
+                    "search; use `model.hp.max_evals`/`model.hp.timeout` to "
+                    "bound it")
             best_cfg, best_score = grid[0], -np.inf
             if len(grid) > 1 and len(X) >= n_splits * 2:
                 # every (config, fold) instance trains in ONE vmapped XLA
                 # launch instead of the reference's sequential hyperopt loop
                 template = factory(grid[0])()
                 best_ci, best_score = gbdt_cv_grid_search(
-                    X, y, is_discrete, grid, n_splits, class_weight, template)
+                    X, y, is_discrete, grid, n_splits, class_weight, template,
+                    timeout_s=float(opt(*_opt_timeout)))
                 best_cfg = grid[best_ci]
             model = factory(best_cfg)()
             model.fit(X, y)

@@ -155,3 +155,39 @@ def test_generate_dep_graph_no_overwrite(adult, tmp_path):
     from delphi_tpu.session import AnalysisException
     with pytest.raises(AnalysisException, match="already exists"):
         delphi.misc.options(opts).generateDepGraph()
+
+
+def test_split_input_table_bisecting_kmeans(adult):
+    # bisect-kmeans (the default) is a real divisive clustering now, not an
+    # alias of kmeans++: k clusters, every row labeled, deterministic
+    df1 = delphi.misc.options({
+        "table_name": "adult", "row_id": "tid", "k": "4",
+        "clustering_alg": "bisect-kmeans"}).splitInputTable()
+    assert set(df1["k"].unique()) == {0, 1, 2, 3}
+    df2 = delphi.misc.options({
+        "table_name": "adult", "row_id": "tid", "k": "4",
+        "clustering_alg": "bisect-kmeans"}).splitInputTable()
+    assert (df1["k"] == df2["k"]).all()
+
+
+def test_bisecting_kmeans_degenerate_rows():
+    import numpy as np
+    from delphi_tpu.ops.cluster import bisecting_kmeans
+    X = np.zeros((6, 8), dtype=np.float32)  # identical rows force the
+    labels = bisecting_kmeans(X, 3)         # forced-division path
+    assert len(set(labels.tolist())) == 3
+
+
+def test_gbdt_cv_timeout_returns_first_config():
+    import numpy as np
+    import pandas as pd
+    from delphi_tpu.models.gbdt import GradientBoostedTreesModel, gbdt_cv_grid_search
+    from delphi_tpu.train import _GBDT_GRID
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 5, (64, 3)).astype(np.float64)
+    y = pd.Series((X[:, 0] % 2).astype(str))
+    tmpl = GradientBoostedTreesModel(True, 2)
+    # an already-expired deadline: no fold launches happen, config 0 wins
+    ci, score = gbdt_cv_grid_search(
+        X, y, True, _GBDT_GRID, 3, "balanced", tmpl, timeout_s=1e-9)
+    assert ci == 0 and score == -np.inf
