@@ -1,0 +1,136 @@
+"""Chunked CSV -> EncodedTable ingestion (SURVEY.md §7 stage 8).
+
+The session catalog holds pandas frames, which is fine up to millions of
+rows; at the 100M-row north star a full object-dtype frame is the memory
+wall. `read_csv_encoded` streams the file in chunks and dictionary-encodes
+each column incrementally — per chunk, values factorize against the growing
+global vocabulary, so peak memory is one chunk of strings plus the int32
+code columns (the reference reaches the same shape via Spark's partitioned
+CSV scan + its executor-side encoders, SURVEY.md §2.3 P1).
+"""
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from delphi_tpu.table import (
+    EncodedColumn, EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, column_kind,
+    _value_strings)
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+
+class _IncrementalEncoder:
+    """Dictionary encoder whose vocabulary grows across chunks."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.kind: Optional[str] = None
+        self.vocab: Dict[str, int] = {}
+        self.code_chunks: List[np.ndarray] = []
+        self.numeric_chunks: List[np.ndarray] = []
+
+    def add(self, series: pd.Series) -> None:
+        # All-null chunks carry no dtype evidence (pandas infers float64):
+        # they match whatever the column turns out to be.
+        all_null = bool(series.isna().all())
+        kind = None if all_null else column_kind(series)
+        if kind is not None:
+            if self.kind is None:
+                self.kind = kind
+            elif self.kind != kind:
+                if {self.kind, kind} == {KIND_INTEGRAL, KIND_FRACTIONAL}:
+                    # whole-file inference would have made this float64
+                    self.kind = KIND_FRACTIONAL
+                else:
+                    from delphi_tpu.session import AnalysisException
+                    raise AnalysisException(
+                        f"Column '{self.name}' changes dtype across chunks "
+                        f"({self.kind} -> {kind}); read the CSV with "
+                        "dtype=str (the default of read_csv_encoded) or a "
+                        "uniform per-column dtype")
+        strings = _value_strings(series, kind or "string")
+        # factorize the chunk locally, then remap chunk codes through the
+        # global vocabulary — one dict lookup per DISTINCT chunk value
+        local_codes, local_vocab = pd.factorize(strings, use_na_sentinel=True)
+        if len(local_vocab) == 0:  # all-NULL chunk
+            codes = np.full(len(strings), -1, dtype=np.int32)
+        else:
+            remap = np.empty(len(local_vocab), dtype=np.int32)
+            for i, v in enumerate(local_vocab):
+                code = self.vocab.get(v)
+                if code is None:
+                    code = len(self.vocab)
+                    self.vocab[v] = code
+                remap[i] = code
+            codes = np.where(local_codes >= 0,
+                             remap[np.maximum(local_codes, 0)],
+                             np.int32(-1)).astype(np.int32)
+        self.code_chunks.append(codes)
+        # numeric view kept for numeric-typed and all-null chunks (NaN); a
+        # string-kind resolution discards it at finish, a kind conflict
+        # raised above, so codes and numeric always stay row-aligned
+        if kind in (KIND_INTEGRAL, KIND_FRACTIONAL) or kind is None:
+            self.numeric_chunks.append(
+                pd.to_numeric(series, errors="coerce").to_numpy(np.float64))
+        else:
+            self.numeric_chunks = []
+
+    def finish(self) -> EncodedColumn:
+        kind = self.kind or "string"  # an entirely-null column
+        codes = np.concatenate(self.code_chunks) if self.code_chunks \
+            else np.zeros(0, np.int32)
+        numeric = None
+        if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
+            assert len(self.numeric_chunks) == len(self.code_chunks)
+            numeric = np.concatenate(self.numeric_chunks)
+        return EncodedColumn(
+            name=self.name, kind=kind, codes=codes,
+            vocab=np.array(list(self.vocab.keys()), dtype=object),
+            numeric=numeric)
+
+
+def encode_table_chunked(chunks: Iterable[pd.DataFrame],
+                         row_id: str) -> EncodedTable:
+    """Builds an EncodedTable from an iterable of pandas chunks without ever
+    materializing the full object-dtype frame."""
+    encoders: Dict[str, _IncrementalEncoder] = {}
+    row_ids: List[np.ndarray] = []
+    row_id_kind: Optional[str] = None
+    order: List[str] = []
+    for chunk in chunks:
+        if row_id not in chunk.columns:
+            from delphi_tpu.session import AnalysisException
+            raise AnalysisException(f"Column '{row_id}' does not exist")
+        row_ids.append(chunk[row_id].to_numpy())
+        if row_id_kind is None:
+            row_id_kind = column_kind(chunk[row_id])
+            order = [c for c in chunk.columns if c != row_id]
+        for name in order:
+            encoders.setdefault(name, _IncrementalEncoder(name)) \
+                .add(chunk[name])
+    assert row_id_kind is not None, "no chunks provided"
+    table = EncodedTable(
+        row_id=row_id,
+        row_id_values=np.concatenate(row_ids),
+        row_id_kind=row_id_kind,
+        columns=[encoders[name].finish() for name in order])
+    _logger.info(
+        f"Chunked ingestion: {table.n_rows} rows x "
+        f"{len(table.columns)} columns encoded")
+    return table
+
+
+def read_csv_encoded(path: str, row_id: str,
+                     chunksize: int = 1_000_000, **read_kwargs) -> EncodedTable:
+    """Streams a CSV into an EncodedTable, `chunksize` rows at a time.
+
+    Columns read as strings by default (chunk-local dtype inference would
+    let the same column flip types between chunks); pass ``dtype`` to type
+    numeric columns explicitly, exactly as the repair example workloads do
+    for pandas reads."""
+    read_kwargs.setdefault("dtype", str)
+    reader = pd.read_csv(path, chunksize=chunksize, **read_kwargs)
+    return encode_table_chunked(reader, row_id)

@@ -36,11 +36,26 @@ def main(argv=None) -> int:
     parser.add_argument("--detect-only", dest="detect_only", action="store_true")
     parser.add_argument("--repair-data", dest="repair_data", action="store_true",
                         help="write the fully repaired table instead of updates")
+    parser.add_argument("--chunksize", dest="chunksize", type=int, default=0,
+                        help="stream the input CSV in chunks of this many "
+                             "rows (0 = load at once); use for inputs too "
+                             "large for one pandas frame")
     args = parser.parse_args(argv)
+
+    # multi-host: join the cluster before any backend use (no-op when
+    # DELPHI_COORDINATOR is unset)
+    from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+    maybe_initialize_distributed()
 
     session = get_session()
     if args.input.endswith(".csv"):
-        name = session.register("batch_input", pd.read_csv(args.input))
+        if args.chunksize > 0:
+            from delphi_tpu.ingest import read_csv_encoded
+            table = read_csv_encoded(args.input, args.row_id,
+                                     chunksize=args.chunksize)
+            name = session.register("batch_input", table)
+        else:
+            name = session.register("batch_input", pd.read_csv(args.input))
     else:
         name = session.qualified_name(args.db, args.input)
 

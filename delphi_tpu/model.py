@@ -1229,6 +1229,18 @@ class RepairModel:
         return repair_candidates_df
 
     def _check_input_table(self) -> Tuple[EncodedTable, str, List[str]]:
+        if isinstance(self.input, str):
+            # chunk-ingested inputs are already encoded in the catalog: use
+            # them directly instead of decoding + re-encoding
+            name = self._session.qualified_name(self.db_name, str(self.input))
+            entry = self._session.raw_entry(name)
+            if isinstance(entry, EncodedTable):
+                from delphi_tpu.table import check_encoded_table
+                table, continuous_columns = check_encoded_table(
+                    entry, self._row_id, name)
+                _logger.info("input_table: {} ({} rows x {} columns)".format(
+                    name, table.n_rows, len(table.columns)))
+                return table, name, continuous_columns
         df, input_name = self._input_frame
         table, continuous_columns = check_input_table(df, self._row_id, input_name)
         _logger.info("input_table: {} ({} rows x {} columns)".format(

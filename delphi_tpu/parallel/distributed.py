@@ -1,0 +1,72 @@
+"""Multi-host substrate: `jax.distributed` wiring over DCN.
+
+The reference scales out through Spark's driver/executor RPC + shuffle
+service (SURVEY.md §2.3); the TPU-native equivalent is one JAX process per
+host joined through `jax.distributed.initialize`, after which
+`jax.devices()` spans every host's chips and the existing mesh/shard_map
+programs run their psums over ICI within a slice and DCN across slices —
+no code changes above this layer.
+
+Opt-in via environment (mirrors how launchers like GKE/SLURM inject rank
+info):
+
+    DELPHI_COORDINATOR=<host:port>   enables multi-host init (required)
+    DELPHI_NUM_PROCESSES=<n>         optional when the launcher provides it
+    DELPHI_PROCESS_ID=<i>            optional when the launcher provides it
+
+Single-process runs (no DELPHI_COORDINATOR) are a no-op.
+"""
+
+import os
+from typing import Optional
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Idempotently joins the multi-host cluster when DELPHI_COORDINATOR is
+    set. Must run before the first backend touch (jax.devices()); callers
+    in this package invoke it from mesh construction and the batch entry
+    point. Returns True when running multi-host."""
+    global _initialized
+    coordinator = os.environ.get("DELPHI_COORDINATOR", "")
+    if not coordinator:
+        return False
+    if _initialized:
+        return True
+
+    import jax
+
+    kwargs = {"coordinator_address": coordinator}
+    num = os.environ.get("DELPHI_NUM_PROCESSES", "")
+    pid = os.environ.get("DELPHI_PROCESS_ID", "")
+    if num:
+        kwargs["num_processes"] = int(num)
+    if pid:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    _logger.info(
+        f"jax.distributed initialized: process {jax.process_index()} of "
+        f"{jax.process_count()}, {len(jax.devices())} global devices")
+    return True
+
+
+def process_local_rows(n_rows: int) -> Optional[slice]:
+    """The contiguous row range this process should ingest when every host
+    reads a shard of the input (None single-process). Row counts that don't
+    divide evenly give the remainder to the last process."""
+    import jax
+
+    count = jax.process_count()
+    if count <= 1:
+        return None
+    per = n_rows // count
+    i = jax.process_index()
+    start = i * per
+    stop = n_rows if i == count - 1 else start + per
+    return slice(start, stop)

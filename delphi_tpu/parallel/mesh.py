@@ -35,6 +35,10 @@ def get_active_mesh() -> Optional[Mesh]:
             f"'0'/'off' to disable, but '{setting}' found")
     key = setting
     if key not in _active_mesh_cache:
+        # multi-host: join the cluster before the first backend touch so
+        # jax.devices() spans every host (no-op without DELPHI_COORDINATOR)
+        from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+        maybe_initialize_distributed()
         n_devices = None if setting == "auto" else int(setting)
         available = len(jax.devices())
         if n_devices is None and available <= 1:
@@ -81,9 +85,23 @@ def pad_rows_to_multiple(array: np.ndarray, multiple: int,
 
 
 def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
-    """Places an array on the mesh sharded along axis 0."""
+    """Places an array on the mesh sharded along axis 0.
+
+    Multi-host: callers pass the GLOBAL array (every process computes the
+    same host-side table today); each process contributes only its row
+    block, so no cross-host copy happens. Row counts are padded to a
+    multiple of the total dp size (padded_row_target), which the process
+    count divides, so the equal-block split is exact."""
     spec = P(axis, *([None] * (array.ndim - 1)))
-    return jax.device_put(array, NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        from delphi_tpu.parallel.distributed import process_local_rows
+        block = process_local_rows(array.shape[0])
+        assert block is not None
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(array[block]),
+            global_shape=array.shape)
+    return jax.device_put(array, sharding)
 
 
 def padded_row_target(n: int, mesh: Optional[Mesh], axis: str = "dp") -> int:

@@ -40,8 +40,15 @@ class DelphiSession:
 
     # -- catalog ------------------------------------------------------------
 
-    def register(self, name: str, df: pd.DataFrame) -> str:
-        assert isinstance(df, pd.DataFrame), f"expected pandas DataFrame, got {type(df)}"
+    def register(self, name: str, df) -> str:
+        # the catalog holds pandas frames OR pre-encoded tables (chunked
+        # ingestion registers EncodedTable directly so the full object-dtype
+        # frame never materializes; see delphi_tpu.ingest)
+        from_pandas = isinstance(df, pd.DataFrame)
+        if not from_pandas:
+            from delphi_tpu.table import EncodedTable
+            assert isinstance(df, EncodedTable), \
+                f"expected pandas DataFrame or EncodedTable, got {type(df)}"
         self._catalog[name] = df
         return name
 
@@ -50,6 +57,12 @@ class DelphiSession:
         return self.register(name, df)
 
     def table(self, name: str) -> pd.DataFrame:
+        entry = self.raw_entry(name)
+        return entry if isinstance(entry, pd.DataFrame) else entry.to_pandas()
+
+    def raw_entry(self, name: str):
+        """The catalog object as stored (EncodedTable for chunk-ingested
+        inputs), bypassing the pandas conversion of :meth:`table`."""
         if name not in self._catalog:
             raise AnalysisException(f"Table or view not found: {name}")
         return self._catalog[name]

@@ -242,6 +242,29 @@ def check_input_table(df: pd.DataFrame, row_id: str, qualified_name: str = "inpu
     return table, table.continuous_columns()
 
 
+def check_encoded_table(table: EncodedTable, row_id: str,
+                        qualified_name: str = "input") \
+        -> Tuple[EncodedTable, List[str]]:
+    """`check_input_table` for a pre-encoded table (chunked ingestion): same
+    validations, no re-encode — the type whitelist already held at encode
+    time, so only shape and row-id checks remain."""
+    if table.row_id != row_id:
+        raise AnalysisException(
+            f"Column '{row_id}' does not exist in '{qualified_name}'.")
+    if len(table.columns) < 2:
+        raise AnalysisException(
+            f"A least three columns (`{row_id}` columns + two more ones) "
+            f"in table '{qualified_name}'")
+    n_rows = table.n_rows
+    n_distinct = len(pd.unique(table.row_id_values))
+    if n_distinct != n_rows:
+        raise AnalysisException(
+            f"Uniqueness does not hold in column '{row_id}' of table "
+            f"'{qualified_name}' (# of distinct '{row_id}': {n_distinct}, "
+            f"# of rows: {n_rows})")
+    return table, table.continuous_columns()
+
+
 @dataclass
 class DiscretizedTable:
     """The discretized view used by the stats engine.

@@ -1,0 +1,106 @@
+"""Chunked ingestion + multi-host substrate (SURVEY.md §7 stage 8)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from conftest import load_testdata
+
+from delphi_tpu.ingest import encode_table_chunked, read_csv_encoded
+from delphi_tpu.table import encode_table
+
+
+def _chunks(df: pd.DataFrame, size: int):
+    for s in range(0, len(df), size):
+        yield df.iloc[s:s + size]
+
+
+def test_chunked_encoding_matches_whole_table(adult_df):
+    whole = encode_table(adult_df, "tid")
+    chunked = encode_table_chunked(_chunks(adult_df, 7), "tid")
+    assert chunked.n_rows == whole.n_rows
+    assert chunked.column_names == whole.column_names
+    for name in whole.column_names:
+        cw, cc = whole.column(name), chunked.column(name)
+        assert cw.kind == cc.kind
+        # decoded values (not raw codes: vocab order may differ) must agree
+        np.testing.assert_array_equal(cw.decode(), cc.decode())
+        assert cw.domain_size == cc.domain_size
+        if cw.numeric is not None:
+            np.testing.assert_allclose(cw.numeric, cc.numeric)
+
+
+def test_read_csv_encoded_hospital():
+    table = read_csv_encoded("/root/reference/testdata/hospital.csv", "tid",
+                             chunksize=123, dtype=str)
+    assert table.n_rows == 1000
+    assert len(table.columns) == 19
+
+
+def test_pipeline_accepts_encoded_table(adult_df, session):
+    """A chunk-ingested EncodedTable registered in the catalog repairs
+    identically to the pandas path."""
+    from delphi_tpu import NullErrorDetector, delphi
+
+    delphi.register_table("adult_pd", adult_df)
+    session.register("adult_enc", encode_table_chunked(_chunks(adult_df, 6),
+                                                       "tid"))
+
+    def run(name):
+        return delphi.repair.setTableName(name).setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]).run() \
+            .sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+    pd.testing.assert_frame_equal(run("adult_pd"), run("adult_enc"))
+
+
+def test_distributed_noop_without_coordinator(monkeypatch):
+    from delphi_tpu.parallel import distributed
+
+    monkeypatch.delenv("DELPHI_COORDINATOR", raising=False)
+    assert distributed.maybe_initialize_distributed() is False
+    assert distributed.process_local_rows(100) is None
+
+
+def test_process_local_rows_split(monkeypatch):
+    import jax
+
+    from delphi_tpu.parallel import distributed
+
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 3)
+    # last process takes the remainder
+    assert distributed.process_local_rows(103) == slice(75, 103)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert distributed.process_local_rows(103) == slice(0, 25)
+
+
+def test_chunked_all_null_chunk_matches_column_kind():
+    c1 = pd.DataFrame({"tid": [0, 1], "v": ["a", "b"], "w": [1.5, 2.5]})
+    c2 = pd.DataFrame({"tid": [2, 3], "v": [None, None],
+                       "w": [np.nan, np.nan]})
+    t = encode_table_chunked(iter([c1, c2]), "tid")
+    assert t.column("v").kind == "string"
+    assert t.column("w").kind == "fractional"
+    assert t.column("v").numeric is None
+    np.testing.assert_allclose(t.column("w").numeric,
+                               [1.5, 2.5, np.nan, np.nan])
+    # row alignment survives the all-null chunk
+    assert len(t.column("v").codes) == 4
+    t.to_pandas()  # must not raise
+
+
+def test_chunked_int_then_float_promotes():
+    c1 = pd.DataFrame({"tid": [0, 1], "v": [1, 2], "w": ["a", "b"]})
+    c2 = pd.DataFrame({"tid": [2], "v": [3.5], "w": ["c"]})
+    t = encode_table_chunked(iter([c1, c2]), "tid")
+    assert t.column("v").kind == "fractional"
+    np.testing.assert_allclose(t.column("v").numeric, [1.0, 2.0, 3.5])
+
+
+def test_chunked_conflicting_dtypes_raise():
+    from delphi_tpu.session import AnalysisException
+    c1 = pd.DataFrame({"tid": [0], "v": [1], "w": ["a"]})
+    c2 = pd.DataFrame({"tid": [1], "v": ["oops"], "w": ["b"]})
+    with pytest.raises(AnalysisException, match="changes dtype"):
+        encode_table_chunked(iter([c1, c2]), "tid")
