@@ -15,8 +15,8 @@ import numpy as np
 import pandas as pd
 
 from delphi_tpu.table import (
-    EncodedColumn, EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, column_kind,
-    _value_strings)
+    EncodedColumn, EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, KIND_STRING,
+    column_kind, _value_strings)
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -42,7 +42,28 @@ class _IncrementalEncoder:
                 self.kind = kind
             elif self.kind != kind:
                 if {self.kind, kind} == {KIND_INTEGRAL, KIND_FRACTIONAL}:
-                    # whole-file inference would have made this float64
+                    # whole-file inference would have made this float64; the
+                    # integral-formatted vocab entries already handed out
+                    # ("1") must become their fractional spellings ("1.0")
+                    # so earlier chunks' codes keep pointing at the value
+                    # they encoded. Beyond 2^53 the float cast is lossy and
+                    # distinct ints can respell identically — exactly the
+                    # values float64 whole-file inference would merge — so
+                    # colliding codes are remapped in the emitted chunks.
+                    if self.kind == KIND_INTEGRAL:
+                        new_vocab: Dict[str, int] = {}
+                        remap_old = np.empty(len(self.vocab), np.int32)
+                        for k, c in self.vocab.items():
+                            nk = str(float(int(k)))
+                            nc = new_vocab.setdefault(nk, len(new_vocab))
+                            remap_old[c] = nc
+                        if len(new_vocab) != len(self.vocab):
+                            self.code_chunks = [
+                                np.where(ch >= 0,
+                                         remap_old[np.maximum(ch, 0)],
+                                         ch).astype(np.int32)
+                                for ch in self.code_chunks]
+                        self.vocab = new_vocab
                     self.kind = KIND_FRACTIONAL
                 else:
                     from delphi_tpu.session import AnalysisException
@@ -51,7 +72,9 @@ class _IncrementalEncoder:
                         f"({self.kind} -> {kind}); read the CSV with "
                         "dtype=str (the default of read_csv_encoded) or a "
                         "uniform per-column dtype")
-        strings = _value_strings(series, kind or "string")
+        # format with the RESOLVED kind, not the chunk's: an integral chunk
+        # arriving after the column resolved fractional must spell 1 as "1.0"
+        strings = _value_strings(series, self.kind or "string")
         # factorize the chunk locally, then remap chunk codes through the
         # global vocabulary — one dict lookup per DISTINCT chunk value
         local_codes, local_vocab = pd.factorize(strings, use_na_sentinel=True)
@@ -69,10 +92,13 @@ class _IncrementalEncoder:
                              remap[np.maximum(local_codes, 0)],
                              np.int32(-1)).astype(np.int32)
         self.code_chunks.append(codes)
-        # numeric view kept for numeric-typed and all-null chunks (NaN); a
-        # string-kind resolution discards it at finish, a kind conflict
-        # raised above, so codes and numeric always stay row-aligned
-        if kind in (KIND_INTEGRAL, KIND_FRACTIONAL) or kind is None:
+        # numeric view kept for numeric-typed and all-null chunks (NaN); once
+        # the column resolves string the view is dead — stop converting
+        # instead of accumulating float64 arrays finish() would discard. A
+        # kind conflict raised above, so codes and numeric stay row-aligned.
+        if self.kind == KIND_STRING:
+            self.numeric_chunks = []
+        elif kind in (KIND_INTEGRAL, KIND_FRACTIONAL) or kind is None:
             self.numeric_chunks.append(
                 pd.to_numeric(series, errors="coerce").to_numpy(np.float64))
         else:

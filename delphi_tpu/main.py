@@ -40,6 +40,13 @@ def main(argv=None) -> int:
                         help="stream the input CSV in chunks of this many "
                              "rows (0 = load at once); use for inputs too "
                              "large for one pandas frame")
+    parser.add_argument("--dtype", dest="dtype", choices=["infer", "str"],
+                        default="infer",
+                        help="chunked-read column typing: 'infer' matches "
+                             "the non-chunked path (numeric columns stay "
+                             "numeric; a column that mixes strings and "
+                             "numbers across chunks fails loudly), 'str' "
+                             "reads everything as strings")
     args = parser.parse_args(argv)
 
     # multi-host: join the cluster before any backend use (no-op when
@@ -51,11 +58,19 @@ def main(argv=None) -> int:
     if args.input.endswith(".csv"):
         if args.chunksize > 0:
             from delphi_tpu.ingest import read_csv_encoded
-            table = read_csv_encoded(args.input, args.row_id,
-                                     chunksize=args.chunksize)
+            # dtype=None -> per-chunk pandas inference, so numeric columns
+            # keep their regression path exactly like the pd.read_csv branch
+            # below (the incremental encoder reconciles int/float across
+            # chunks and raises on a genuine string/number conflict)
+            table = read_csv_encoded(
+                args.input, args.row_id, chunksize=args.chunksize,
+                dtype=str if args.dtype == "str" else None)
             name = session.register("batch_input", table)
         else:
-            name = session.register("batch_input", pd.read_csv(args.input))
+            name = session.register(
+                "batch_input",
+                pd.read_csv(args.input,
+                            dtype=str if args.dtype == "str" else None))
     else:
         name = session.qualified_name(args.db, args.input)
 

@@ -1048,7 +1048,10 @@ class RepairModel:
             # Every expert option is part of the identity: error.* knobs shape
             # the stats that feed feature selection, model.* shape training.
             # (repair.pmf.* retrains unnecessarily but never reuses stale.)
-            "opts": dict(sorted(self.opts.items())),
+            # `model.checkpoint_path` itself is excluded so a relocated
+            # checkpoint directory still validates against its contents.
+            "opts": {k: v for k, v in sorted(self.opts.items())
+                     if k != self._opt_checkpoint_path.key},
             # Setter-based knobs that change which models get built.
             "discrete_thres": int(self.discrete_thres),
             "repair_by_rules": bool(self.repair_by_rules),
@@ -1056,6 +1059,12 @@ class RepairModel:
         }
 
     def _load_model_checkpoint(self, fingerprint: Dict[str, Any]) -> Optional[List[Any]]:
+        # Trust boundary: checkpoints are plain pickles, and unpickling runs
+        # arbitrary code. Point `model.checkpoint_path` only at directories
+        # you (or this process) wrote — never at untrusted files. This is the
+        # same boundary the reference draws around its pickled model blobs
+        # (reference python/repair/model.py:910,921 transports models with
+        # CloudPickle under the same assumption).
         ckpt = self._checkpoint_file()
         if not ckpt or not os.path.exists(ckpt):
             return None

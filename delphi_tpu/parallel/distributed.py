@@ -18,7 +18,6 @@ Single-process runs (no DELPHI_COORDINATOR) are a no-op.
 """
 
 import os
-from typing import Optional
 
 from delphi_tpu.utils import setup_logger
 
@@ -56,17 +55,3 @@ def maybe_initialize_distributed() -> bool:
     return True
 
 
-def process_local_rows(n_rows: int) -> Optional[slice]:
-    """The contiguous row range this process should ingest when every host
-    reads a shard of the input (None single-process). Row counts that don't
-    divide evenly give the remainder to the last process."""
-    import jax
-
-    count = jax.process_count()
-    if count <= 1:
-        return None
-    per = n_rows // count
-    i = jax.process_index()
-    start = i * per
-    stop = n_rows if i == count - 1 else start + per
-    return slice(start, stop)

@@ -88,19 +88,18 @@ def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
     """Places an array on the mesh sharded along axis 0.
 
     Multi-host: callers pass the GLOBAL array (every process computes the
-    same host-side table today); each process contributes only its row
-    block, so no cross-host copy happens. Row counts are padded to a
-    multiple of the total dp size (padded_row_target), which the process
-    count divides, so the equal-block split is exact."""
+    same host-side table today); the callback hands each ADDRESSABLE device
+    exactly its shard's global index, so each process contributes only the
+    rows its own mesh devices own — correct even when the mesh spans a
+    subset of processes (e.g. DELPHI_MESH=<n> smaller than the cluster,
+    where an even process_count split would have non-member processes
+    contributing rows to shards they don't hold)."""
     spec = P(axis, *([None] * (array.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() > 1:
-        from delphi_tpu.parallel.distributed import process_local_rows
-        block = process_local_rows(array.shape[0])
-        assert block is not None
-        return jax.make_array_from_process_local_data(
-            sharding, np.ascontiguousarray(array[block]),
-            global_shape=array.shape)
+        return jax.make_array_from_callback(
+            array.shape, sharding,
+            lambda idx: np.ascontiguousarray(array[idx]))
     return jax.device_put(array, sharding)
 
 

@@ -117,12 +117,27 @@ def _cv_score(make_model, X: np.ndarray, y: pd.Series, is_discrete: bool,
 # Candidate hyperparameter grid evaluated by CV — the compact stand-in for the
 # reference's hyperopt TPE search (train.py:148-209); shallow, strongly
 # regularized configs win on small tables, deeper ones on large.
+# The search grid spans the same axes the reference's TPE space explores
+# (reference train.py:148-156: reg_lambda loguniform(-2,3), min_child_weight
+# loguniform(-3,1), tree-size knobs) but as a fixed grid the batched CV can
+# evaluate in one vmapped launch per (depth, rounds) shape group — configs
+# within a group add vmap width, not compiles. Ordered so that
+# `model.hp.max_evals` prefix-slicing keeps the strongest defaults first.
 _GBDT_GRID = [
     dict(max_depth=3, reg_lambda=3.0, learning_rate=0.05, n_estimators=300),
     dict(max_depth=3, reg_lambda=1.0, learning_rate=0.1, n_estimators=200),
     dict(max_depth=5, reg_lambda=1.0, learning_rate=0.1, n_estimators=200),
     dict(max_depth=5, reg_lambda=1.0, learning_rate=0.1, n_estimators=200,
          min_child_weight=5.0),
+    dict(max_depth=3, reg_lambda=0.15, learning_rate=0.1, n_estimators=200),
+    dict(max_depth=3, reg_lambda=10.0, learning_rate=0.05, n_estimators=200),
+    dict(max_depth=3, reg_lambda=1.0, learning_rate=0.2, n_estimators=200,
+         min_child_weight=0.05),
+    dict(max_depth=5, reg_lambda=5.0, learning_rate=0.05, n_estimators=200),
+    dict(max_depth=5, reg_lambda=0.15, learning_rate=0.1, n_estimators=200,
+         min_child_weight=0.5),
+    dict(max_depth=5, reg_lambda=1.0, learning_rate=0.2, n_estimators=200,
+         min_child_weight=2.5),
 ]
 
 

@@ -36,8 +36,12 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
-TESTDATA = pathlib.Path("/root/reference/testdata")
-BIN_TESTDATA = pathlib.Path("/root/reference/bin/testdata")
+# Reference fixture CSVs; override when the reference checkout lives
+# elsewhere (e.g. CI clones it into the workspace).
+TESTDATA = pathlib.Path(
+    os.environ.get("DELPHI_TESTDATA", "/root/reference/testdata"))
+BIN_TESTDATA = pathlib.Path(
+    os.environ.get("DELPHI_BIN_TESTDATA", "/root/reference/bin/testdata"))
 
 
 def load_testdata(name: str, **kwargs) -> pd.DataFrame:

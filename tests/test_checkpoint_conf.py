@@ -75,6 +75,20 @@ def test_inject_null_seed_validation(adult):
     pd.testing.assert_frame_equal(df1, df2)
 
 
+def test_checkpoint_survives_relocation(adult, tmp_path):
+    import shutil
+    src = tmp_path / "a"
+    dst = tmp_path / "b"
+    _repair_model(src).run()
+    shutil.move(str(src), str(dst))
+    ckpt = dst / "repair_models.pkl"
+    mtime = os.path.getmtime(ckpt)
+    # The fingerprint excludes model.checkpoint_path itself, so pointing at
+    # the moved directory reuses the models instead of silently retraining.
+    _repair_model(dst).run()
+    assert os.path.getmtime(ckpt) == mtime, "relocated checkpoint must reuse"
+
+
 def test_checkpoint_unreadable_file_ignored(adult, tmp_path):
     (tmp_path / "repair_models.pkl").write_bytes(b"not a pickle")
     df = _repair_model(tmp_path).run()

@@ -7,6 +7,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+from conftest import TESTDATA
+
 from delphi_tpu import constraints as dc
 from delphi_tpu.errors import (
     ConstraintErrorDetector, DomainValues, ErrorModel, GaussianOutlierErrorDetector,
@@ -186,7 +188,7 @@ def test_constraint_detector_lt():
 
 def test_constraint_detector_one_tuple(adult_df):
     d = _setup(ConstraintErrorDetector(
-        constraint_path="/root/reference/testdata/adult_constraints.txt"), adult_df)
+        constraint_path=str(TESTDATA / "adult_constraints.txt")), adult_df)
     got = _cells(d.detect())
     # rows where Sex=Female & Relationship=Husband, or Sex=Male & Relationship=Wife
     raw = adult_df
@@ -210,7 +212,7 @@ def test_constraint_detector_targets_filter():
 
 def test_constraint_detector_hospital_runs(hospital_df):
     d = _setup(ConstraintErrorDetector(
-        constraint_path="/root/reference/testdata/hospital_constraints.txt"),
+        constraint_path=str(TESTDATA / "hospital_constraints.txt")),
         hospital_df)
     cells = d.detect()
     assert len(cells) > 0

@@ -4,7 +4,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from conftest import load_testdata
+from conftest import TESTDATA
 
 from delphi_tpu.ingest import encode_table_chunked, read_csv_encoded
 from delphi_tpu.table import encode_table
@@ -31,7 +31,7 @@ def test_chunked_encoding_matches_whole_table(adult_df):
 
 
 def test_read_csv_encoded_hospital():
-    table = read_csv_encoded("/root/reference/testdata/hospital.csv", "tid",
+    table = read_csv_encoded(str(TESTDATA / "hospital.csv"), "tid",
                              chunksize=123, dtype=str)
     assert table.n_rows == 1000
     assert len(table.columns) == 19
@@ -59,20 +59,36 @@ def test_distributed_noop_without_coordinator(monkeypatch):
 
     monkeypatch.delenv("DELPHI_COORDINATOR", raising=False)
     assert distributed.maybe_initialize_distributed() is False
-    assert distributed.process_local_rows(100) is None
 
 
-def test_process_local_rows_split(monkeypatch):
+def test_shard_rows_uses_sharding_indices(monkeypatch):
+    """Multi-process placement derives each contribution from the sharding's
+    own index map (make_array_from_callback), so it stays correct when the
+    mesh covers a subset of processes; single-process path unchanged."""
     import jax
 
-    from delphi_tpu.parallel import distributed
+    from delphi_tpu.parallel.mesh import make_mesh, shard_rows
 
-    monkeypatch.setattr(jax, "process_count", lambda: 4)
-    monkeypatch.setattr(jax, "process_index", lambda: 3)
-    # last process takes the remainder
-    assert distributed.process_local_rows(103) == slice(75, 103)
-    monkeypatch.setattr(jax, "process_index", lambda: 0)
-    assert distributed.process_local_rows(103) == slice(0, 25)
+    mesh = make_mesh(4)
+    data = np.arange(32, dtype=np.int32).reshape(8, 4)
+    seen = []
+    real_cb = jax.make_array_from_callback
+
+    def spy(shape, sharding, cb):
+        def wrapped(idx):
+            block = cb(idx)
+            seen.append((idx, block))
+            return block
+        return real_cb(shape, sharding, wrapped)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "make_array_from_callback", spy)
+    arr = shard_rows(data, mesh)
+    np.testing.assert_array_equal(np.asarray(arr), data)
+    # every shard handed out exactly the rows its global index names
+    assert seen
+    for idx, block in seen:
+        np.testing.assert_array_equal(block, data[idx])
 
 
 def test_chunked_all_null_chunk_matches_column_kind():
@@ -96,6 +112,59 @@ def test_chunked_int_then_float_promotes():
     t = encode_table_chunked(iter([c1, c2]), "tid")
     assert t.column("v").kind == "fractional"
     np.testing.assert_allclose(t.column("v").numeric, [1.0, 2.0, 3.5])
+
+
+def test_chunked_int_float_promotion_matches_whole_table():
+    """Promotion must re-spell already-encoded integral vocab ("1" -> "1.0")
+    so a value seen as int in one chunk and float in another gets ONE code,
+    exactly like whole-table float64 inference."""
+    df = pd.DataFrame({"tid": [0, 1, 2, 3],
+                       "v": [1.0, 2.0, 1.0, 3.5]})
+    c1 = pd.DataFrame({"tid": [0, 1], "v": pd.array([1, 2], dtype="int64")})
+    c2 = pd.DataFrame({"tid": [2, 3], "v": [1.0, 3.5]})
+    whole = encode_table(df, "tid").column("v")
+    chunked = encode_table_chunked(iter([c1, c2]), "tid").column("v")
+    assert chunked.domain_size == whole.domain_size == 3
+    np.testing.assert_array_equal(whole.decode(), chunked.decode())
+    # and the reverse arrival order (float first, then an integral chunk)
+    rev = encode_table_chunked(
+        iter([c2.assign(tid=[0, 1]), c1.assign(tid=[2, 3])]),
+        "tid").column("v")
+    assert rev.domain_size == 3
+    assert sorted(rev.vocab) == sorted(whole.vocab)
+
+
+def test_chunked_promotion_merges_lossy_int64(tmp_path):
+    """Ints beyond 2^53 that respell to the same float string on promotion
+    must merge into ONE code (what float64 whole-file inference does), with
+    earlier chunks' codes remapped — not silently collide."""
+    big = 9007199254740992  # 2^53; +1 is not representable in float64
+    c1 = pd.DataFrame({"tid": [0, 1],
+                       "v": pd.array([big, big + 1], dtype="int64")})
+    c2 = pd.DataFrame({"tid": [2], "v": [1.5]})
+    col = encode_table_chunked(iter([c1, c2]), "tid").column("v")
+    assert col.kind == "fractional"
+    assert col.domain_size == 2  # {9007199254740992.0, 1.5}
+    decoded = col.decode()
+    assert decoded[0] == decoded[1] == str(float(big))
+    assert decoded[2] == "1.5"
+
+
+def test_cli_chunksize_keeps_numeric_columns(tmp_path):
+    """--chunksize must not demote numeric columns to strings: the chunked
+    and non-chunked CLI paths repair the same file identically."""
+    from delphi_tpu.main import main
+
+    src = str(TESTDATA / "iris.csv")
+    out1, out2 = str(tmp_path / "whole.csv"), str(tmp_path / "chunked.csv")
+    assert main(["--input", src, "--row-id", "tid", "--output", out1]) == 0
+    assert main(["--input", src, "--row-id", "tid", "--output", out2,
+                 "--chunksize", "37"]) == 0
+    r1 = pd.read_csv(out1).sort_values(["tid", "attribute"]) \
+        .reset_index(drop=True)
+    r2 = pd.read_csv(out2).sort_values(["tid", "attribute"]) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(r1, r2)
 
 
 def test_chunked_conflicting_dtypes_raise():

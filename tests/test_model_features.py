@@ -15,7 +15,7 @@ from delphi_tpu.costs import Levenshtein
 from delphi_tpu.errors import ConstraintErrorDetector, NullErrorDetector
 from delphi_tpu.session import AnalysisException
 
-from conftest import load_testdata
+from conftest import TESTDATA, load_testdata
 
 
 @pytest.fixture
@@ -315,7 +315,7 @@ def test_compute_repair_score_schema(adult):
 def test_compute_weighted_probs_for_target_attributes(adult, session):
     # reference test_model.py:1022-1059: a huge Levenshtein cost weight on one
     # attribute pushes its top-candidate prob to ~1 and leaves others alone.
-    constraint_path = "/root/reference/testdata/adult_constraints.txt"
+    constraint_path = str(TESTDATA / "adult_constraints.txt")
     m = delphi.repair.setInput("adult").setRowId("tid") \
         .setTargets(["Sex", "Relationship"]) \
         .setErrorDetectors([ConstraintErrorDetector(constraint_path)])

@@ -23,13 +23,13 @@ from delphi_tpu.costs import UserDefinedUpdateCostFunction
 from delphi_tpu.errors import (
     ConstraintErrorDetector, DomainValues, NullErrorDetector, RegExErrorDetector)
 
-from conftest import load_testdata
+from conftest import BIN_TESTDATA, load_testdata
 
 pytestmark = pytest.mark.skipif(
     not os.environ.get("DELPHI_PERF_TESTS"),
     reason="perf gates only run when DELPHI_PERF_TESTS is set")
 
-CONSTRAINT_PATH = "/root/reference/bin/testdata/hospital_constraints.txt"
+CONSTRAINT_PATH = str(BIN_TESTDATA / "hospital_constraints.txt")
 
 HOSPITAL_TARGETS = [
     "City", "HospitalName", "ZipCode", "Score", "ProviderNumber", "Sample",
