@@ -1033,11 +1033,14 @@ class RepairModel:
         and schema, a cheap content hash, and every model.* option. A
         checkpoint is only reused when all of these match, so a different
         table (or the same table with edited rows/options) retrains."""
+        # hash the columns in their native dtypes — astype(str) would copy
+        # the whole table just to fingerprint it, an O(n) string
+        # materialization that matters at the 1e8-row north star
         content = hashlib.sha1(
             pd.util.hash_pandas_object(
-                train_df.astype(str), index=False).values.tobytes()).hexdigest()
+                train_df, index=False).values.tobytes()).hexdigest()
         return {
-            "version": 2,
+            "version": 3,
             "input": self._session.qualified_name(
                 self.db_name,
                 self.input if isinstance(self.input, str) else "<dataframe>"),

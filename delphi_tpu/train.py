@@ -166,6 +166,16 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 return make
 
             grid = _GBDT_GRID[: max(1, min(len(_GBDT_GRID), max_evals))]
+            if _opt_max_evals.key not in opts:
+                import jax
+                if jax.default_backend() == "cpu":
+                    # Platform-aware search depth: on an accelerator the
+                    # extra configs ride the same vmapped launches almost
+                    # free, but on a CPU host every config costs real
+                    # sequential FLOPs — default to the 4 strongest configs
+                    # (the pre-widening grid) unless the caller raises
+                    # `model.hp.max_evals` explicitly.
+                    grid = grid[:4]
             if is_discrete and num_class > 8:
                 # wide multiclass: CV grid search is too costly for the gain
                 grid = grid[:1]
