@@ -101,18 +101,23 @@ class RepairMisc:
         return pd.DataFrame(rows)
 
     def flatten(self) -> pd.DataFrame:
-        """(row_id, attribute, value) long view (RepairMiscApi.scala:41-49)."""
+        """(row_id, attribute, value) long view (RepairMiscApi.scala:41-49).
+
+        Values CAST to string per source column BEFORE the melt (vectorized
+        over each column's distinct values) — identical output to the
+        per-value formatting since a typed column formats every cell the
+        same way (ints as ``str(int)``, floats as ``str(float)``)."""
+        from delphi_tpu.table import _value_strings, column_kind
+
         self._check_required_options(["table_name", "row_id"])
         df = self._table()
         row_id = self.opts["row_id"]
         value_cols = [c for c in df.columns if c != row_id]
-        out = df.melt(id_vars=[row_id], value_vars=value_cols,
-                      var_name="attribute", value_name="value")
-        out["value"] = out["value"].map(
-            lambda v: None if pd.isna(v)
-            else (str(int(v)) if isinstance(v, (int, np.integer))
-                  else str(float(v)) if isinstance(v, (float, np.floating))
-                  else str(v)))
+        cast = pd.DataFrame({c: _value_strings(df[c], column_kind(df[c]))
+                             for c in value_cols})
+        cast[row_id] = df[row_id].to_numpy()
+        out = cast.melt(id_vars=[row_id], value_vars=value_cols,
+                        var_name="attribute", value_name="value")
         return out
 
     def splitInputTable(self) -> pd.DataFrame:

@@ -14,6 +14,29 @@ import numpy as np
 import pandas as pd
 
 
+def _vocab_codes(series: pd.Series, vocab: Dict[str, int],
+                 default: int) -> np.ndarray:
+    """``vocab.get(str(v), default)`` per cell (NULL -> default), computed
+    over the DISTINCT raw values: one C-speed factorize pass plus a
+    vocab-sized Python loop instead of a per-row lambda. Distinct raw
+    values sharing a string form hit the same vocab entry, exactly like
+    the per-row ``str(v)`` lookup."""
+    try:
+        codes, uniques = pd.factorize(series.to_numpy(), use_na_sentinel=True)
+    except TypeError:
+        # unhashable cell values (e.g. ad-hoc object columns) — per-row path
+        return series.map(
+            lambda v: vocab.get(str(v), default) if pd.notna(v) else default
+        ).to_numpy(dtype=np.int64)
+    if len(uniques) == 0:  # all-NULL column
+        return np.full(len(codes), default, dtype=np.int64)
+    lut = np.fromiter((vocab.get(str(v), default) for v in uniques),
+                      dtype=np.int64, count=len(uniques))
+    return np.where(codes >= 0,
+                    lut[np.maximum(codes, 0)],
+                    np.int64(default))
+
+
 def f1_macro(y_true: np.ndarray, y_pred: np.ndarray) -> float:
     """Macro-averaged F1 over the classes present in ``y_true`` — the CV
     scorer for classifier model selection (the same metric the reference
@@ -97,9 +120,7 @@ class FeatureEncoder:
             else:
                 vocab = self._vocab[f]
                 width = len(vocab) + 1
-                idx = X[f].map(
-                    lambda v: vocab.get(str(v), len(vocab)) if pd.notna(v) else len(vocab)
-                ).to_numpy(dtype=np.int64)
+                idx = _vocab_codes(X[f], vocab, len(vocab))
                 out[np.arange(n), d + idx] = 1.0
                 d += width
         return out
@@ -135,9 +156,7 @@ class OrdinalEncoder:
                             .to_numpy(dtype=np.float64))
             else:
                 vocab = self._vocab[f]
-                codes = X[f].map(
-                    lambda v: vocab.get(str(v), -1) if pd.notna(v) else -1
-                ).to_numpy(dtype=np.float64)
+                codes = _vocab_codes(X[f], vocab, -1).astype(np.float64)
                 codes[codes < 0] = np.nan
                 cols.append(codes)
         return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0))

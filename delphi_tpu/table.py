@@ -49,11 +49,29 @@ def column_kind(series: pd.Series) -> str:
 
 
 def _value_strings(series: pd.Series, kind: str) -> np.ndarray:
-    """String representation of values, matching SQL CAST(x AS STRING)."""
-    if kind == KIND_INTEGRAL:
-        return series.map(lambda v: str(int(v)) if pd.notna(v) else None).to_numpy(dtype=object)
-    if kind == KIND_FRACTIONAL:
-        return series.map(lambda v: str(float(v)) if pd.notna(v) else None).to_numpy(dtype=object)
+    """String representation of values, matching SQL CAST(x AS STRING).
+
+    Formats via the DISTINCT values (factorize, then ``str()`` each unique)
+    so the per-cell cost is a C-speed hash pass instead of a Python lambda
+    per row — ``str(int)`` / ``str(float)`` are injective on the raw values,
+    so first-appearance order and the produced strings are identical to the
+    per-row path. Plain-string columns pass through with only NULL masking;
+    object columns holding non-str values keep the exact per-row ``str()``
+    semantics (distinct objects with equal string forms must still merge)."""
+    if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
+        codes, uniques = pd.factorize(series.to_numpy(), use_na_sentinel=True)
+        cast = (lambda v: str(int(v))) if kind == KIND_INTEGRAL \
+            else (lambda v: str(float(v)))
+        lut = np.array([cast(v) for v in uniques], dtype=object)
+        out = np.empty(len(codes), dtype=object)
+        valid = codes >= 0
+        out[valid] = lut[codes[valid]]
+        out[~valid] = None
+        return out
+    if pd.api.types.infer_dtype(series, skipna=True) in ("string", "empty"):
+        # to_numpy copies when it applies na_value, so the source series'
+        # buffer is never mutated
+        return series.to_numpy(dtype=object, na_value=None)
     return series.map(lambda v: str(v) if pd.notna(v) else None).to_numpy(dtype=object)
 
 
