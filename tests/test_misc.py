@@ -191,3 +191,33 @@ def test_gbdt_cv_timeout_returns_first_config():
     ci, score = gbdt_cv_grid_search(
         X, y, True, _GBDT_GRID, 3, "balanced", tmpl, timeout_s=1e-9)
     assert ci == 0 and score == -np.inf
+
+
+def test_gbdt_grid_platform_default(monkeypatch):
+    """On the CPU backend the default search depth is the 4 strongest
+    configs; an explicit model.hp.max_evals opens the full grid."""
+    import delphi_tpu.train as train
+
+    captured = {}
+
+    def fake_search(X, y, is_discrete, configs, *a, **kw):
+        captured["grid"] = list(configs)
+        return 0, 1.0
+
+    monkeypatch.setattr(train, "_GBDT_GRID", train._GBDT_GRID)
+    import delphi_tpu.models.gbdt as gbdt
+    monkeypatch.setattr(gbdt, "gbdt_cv_grid_search", fake_search)
+
+    import numpy as np
+    import pandas as pd
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 5, (120, 3)).astype(np.float64)
+    y = pd.Series((X[:, 0] % 2).astype(str))
+
+    train._build_jax_model(X, y, True, 2, n_jobs=1, opts={})
+    assert len(captured["grid"]) == 4, "CPU default must trim to 4 configs"
+
+    train._build_jax_model(
+        X, y, True, 2, n_jobs=1, opts={"model.hp.max_evals": "100"})
+    assert len(captured["grid"]) == len(train._GBDT_GRID), \
+        "explicit max_evals opens the full grid"
