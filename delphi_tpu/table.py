@@ -11,7 +11,9 @@ tensor instead of generated SQL.
 """
 
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import os
 
 import numpy as np
 import pandas as pd
@@ -112,13 +114,31 @@ class EncodedColumn:
 
 
 def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColumn:
+    """Dictionary-encodes one attribute.
+
+    Numeric columns factorize the RAW values in one C hash pass and only
+    format the (small) set of distinct values to strings — ``str(int)`` /
+    ``str(float)`` are injective on the raw values, so codes and
+    first-appearance order match encoding the formatted strings. String
+    columns factorize their cast strings. The native C++ encoder is opt-in
+    (``DELPHI_NATIVE_ENCODE=1``): its per-value ctypes marshalling costs
+    more than pandas' vectorized hash table at millions of rows.
+    """
     kind = column_kind(series)
-    strings = _value_strings(series, kind)
-    encoder = get_dict_encoder()
-    if encoder is not None:
-        codes, uniques = encoder.encode(strings.tolist())
+    if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
+        codes, raw_uniques = pd.factorize(series.to_numpy(),
+                                          use_na_sentinel=True)
+        cast = (lambda v: str(int(v))) if kind == KIND_INTEGRAL \
+            else (lambda v: str(float(v)))
+        uniques: Any = np.array([cast(v) for v in raw_uniques], dtype=object)
     else:
-        codes, uniques = pd.factorize(strings, use_na_sentinel=True)
+        strings = _value_strings(series, kind)
+        encoder = get_dict_encoder() \
+            if os.environ.get("DELPHI_NATIVE_ENCODE") == "1" else None
+        if encoder is not None:
+            codes, uniques = encoder.encode(strings.tolist())
+        else:
+            codes, uniques = pd.factorize(strings, use_na_sentinel=True)
     col = EncodedColumn(
         name=name or str(series.name),
         kind=kind,

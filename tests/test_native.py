@@ -61,10 +61,11 @@ def test_dict_encode_empty():
 
 def test_encode_column_native_equals_pandas(monkeypatch):
     """encode_column must produce the same codes/vocab with and without the
-    native encoder."""
+    native encoder (native is opt-in via DELPHI_NATIVE_ENCODE)."""
     import delphi_tpu.table as table_mod
 
     s = pd.Series(["x", None, "y", "x", "z", "y"], name="attr")
+    monkeypatch.setenv("DELPHI_NATIVE_ENCODE", "1")
     with_native = table_mod.encode_column(s)
     monkeypatch.setattr(table_mod, "get_dict_encoder", lambda: None)
     without = table_mod.encode_column(s)
