@@ -102,10 +102,20 @@ def compute_domain_in_error_cells(
         with np.errstate(divide="ignore", invalid="ignore"):
             prob = np.where(denom > 0, score / denom, 0.0)
 
+        # One nonzero + lexsort over every surviving (cell, value) entry
+        # instead of a per-cell scan: Python-level work is proportional to
+        # the kept domain entries (few per cell), not cells x vocabulary.
+        keep_mask = contributed & (prob > beta)
+        cell_idx, val_idx = np.nonzero(keep_mask)
+        probs_sel = prob[cell_idx, val_idx]
+        vocab_sel = vocab[val_idx]
+        order = np.lexsort((vocab_sel, -probs_sel, cell_idx))
+        doms: List[List[Tuple[str, float]]] = [[] for _ in range(len(rows))]
+        for c, v, p in zip(cell_idx[order].tolist(),
+                           vocab_sel[order].tolist(),
+                           probs_sel[order].tolist()):
+            doms[c].append((str(v), float(p)))
         for i, (r, cur) in enumerate(zip(rows, currents)):
-            keep = np.nonzero(contributed[i] & (prob[i] > beta))[0]
-            dom = [(str(vocab[j]), float(prob[i, j])) for j in keep]
-            dom.sort(key=lambda t: (-t[1], t[0]))
-            out.append(CellDomain(int(r), attr, cur, dom))
+            out.append(CellDomain(int(r), attr, cur, doms[i]))
 
     return out
