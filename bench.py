@@ -75,6 +75,7 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
         part["tid"] = (part.index + i * len(hospital)).astype(str)
         parts.append(part)
     big = pd.concat(parts, ignore_index=True)
+    del parts
     delphi.register_table("hospital_big", big)
 
     injected = delphi.misc.options({
@@ -82,6 +83,13 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
         "target_attr_list": "ZipCode,City,State", "null_ratio": "0.03",
         "seed": "0"}).injectNull()
     delphi.register_table("hospital_dirty", injected)
+    # memory hygiene at large --scale: only the dirty table is repaired, so
+    # drop the clean copy (catalog + locals) before the timed run — at 50M
+    # rows the pre-injection frame alone is tens of GB
+    from delphi_tpu.session import get_session
+    get_session().drop("hospital_big")
+    n_rows = int(len(big))
+    del big, injected
 
     jax.block_until_ready(jax.numpy.zeros(8).sum())
 
@@ -107,7 +115,7 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
         "unit": "cells/s",
         "vs_baseline": None,
         "scale": scale,
-        "rows": int(len(big)),
+        "rows": n_rows,
         "repairs": int(len(repaired)),
         "elapsed_s": round(elapsed, 3),
         "device": device,
