@@ -190,13 +190,22 @@ def compute_freq_stats(table: EncodedTable,
                 codes[:, name_to_idx[x]], codes[:, name_to_idx[y]],
                 vocab_sizes[x], vocab_sizes[y])
     if xla_pairs:
-        xi = jnp.asarray([name_to_idx[x] for x, _ in xla_pairs], dtype=jnp.int32)
-        yi = jnp.asarray([name_to_idx[y] for _, y in xla_pairs], dtype=jnp.int32)
-        flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))
         stride = v_pad + 1
-        for p, (x, y) in enumerate(xla_pairs):
-            m = flat[p].reshape(stride, stride)
-            pair_mats[(x, y)] = m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
+        # The vmapped kernel materializes a [pairs, rows] fused-key buffer;
+        # bound it to ~1 GB per launch so 10M+-row tables don't blow device
+        # memory when many candidate pairs arrive at once.
+        per_launch = max(1, int(2.5e8 // max(table.n_rows, 1)))
+        for s in range(0, len(xla_pairs), per_launch):
+            group = xla_pairs[s:s + per_launch]
+            xi = jnp.asarray([name_to_idx[x] for x, _ in group],
+                             dtype=jnp.int32)
+            yi = jnp.asarray([name_to_idx[y] for _, y in group],
+                             dtype=jnp.int32)
+            flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))
+            for p, (x, y) in enumerate(group):
+                m = flat[p].reshape(stride, stride)
+                pair_mats[(x, y)] = \
+                    m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
 
     return FreqStats(
         n_rows=table.n_rows,
@@ -252,11 +261,15 @@ class PairDistinctCounter:
                 todo.append((x, y))
         if len(todo) < 2 or self._table.n_rows < (1 << 14):
             return  # host path is cheaper than a kernel launch
-        for s in range(0, len(todo), self._WARM_CHUNK):
-            chunk = todo[s:s + self._WARM_CHUNK]
+        # Bound the [chunk, rows] code stacks (x2 attrs + lexsort workspace)
+        # to ~1 GB regardless of table size.
+        chunk_size = max(1, min(self._WARM_CHUNK,
+                                int(2.5e8 // self._table.n_rows)))
+        for s in range(0, len(todo), chunk_size):
+            chunk = todo[s:s + chunk_size]
             # pad short chunks by repeating the last pair so every launch
             # shares one compiled (batch) shape; duplicates are discarded
-            padded = chunk + [chunk[-1]] * (self._WARM_CHUNK - len(chunk))
+            padded = chunk + [chunk[-1]] * (chunk_size - len(chunk))
             c1 = np.stack([self._table.column(x).codes for x, _ in padded])
             c2 = np.stack([self._table.column(y).codes for _, y in padded])
             counts = np.asarray(
