@@ -29,6 +29,10 @@ from delphi_tpu.table import EncodedTable
 
 Pair = Tuple[str, str]
 
+# Memory budget for batched pair-stat launches: caps the [pairs, rows]
+# fused-key / code buffers at ~1 GB per launch (int32/64 elements).
+_PAIR_KEYS_PER_LAUNCH = 2.5e8
+
 
 def _pallas_policy() -> str:
     """DELPHI_PALLAS=1 forces the pallas kernels (interpret mode off-TPU),
@@ -194,7 +198,7 @@ def compute_freq_stats(table: EncodedTable,
         # The vmapped kernel materializes a [pairs, rows] fused-key buffer;
         # bound it to ~1 GB per launch so 10M+-row tables don't blow device
         # memory when many candidate pairs arrive at once.
-        per_launch = max(1, int(2.5e8 // max(table.n_rows, 1)))
+        per_launch = max(1, int(_PAIR_KEYS_PER_LAUNCH // max(table.n_rows, 1)))
         for s in range(0, len(xla_pairs), per_launch):
             group = xla_pairs[s:s + per_launch]
             xi = jnp.asarray([name_to_idx[x] for x, _ in group],
@@ -264,7 +268,8 @@ class PairDistinctCounter:
         # Bound the [chunk, rows] code stacks (x2 attrs + lexsort workspace)
         # to ~1 GB regardless of table size.
         chunk_size = max(1, min(self._WARM_CHUNK,
-                                int(2.5e8 // self._table.n_rows)))
+                                int(_PAIR_KEYS_PER_LAUNCH
+                                    // self._table.n_rows)))
         for s in range(0, len(todo), chunk_size):
             chunk = todo[s:s + chunk_size]
             # pad short chunks by repeating the last pair so every launch

@@ -161,3 +161,49 @@ class TestComputeDomain:
             self.disc, [(0, "z", "1")], ["z"], ["z"], self.freq,
             {"z": [("x", 0.5)]}, self.domain_stats, 4, 0.0, 0.01)
         assert doms[0].domain == []
+
+
+def test_pair_counts_chunked_launches_match(monkeypatch, adult_df):
+    """Shrinking the per-launch key budget forces multiple pair-count
+    launches; counts must be identical to the single-launch path."""
+    import delphi_tpu.ops.freq as freq_mod
+    from delphi_tpu.table import encode_table
+
+    table = encode_table(adult_df, "tid")
+    attrs = [c for c in table.column_names][:4]
+    pairs = [(x, y) for i, x in enumerate(attrs) for y in attrs[i + 1:]]
+
+    whole = freq_mod.compute_freq_stats(table, attrs, pairs)
+    monkeypatch.setattr(freq_mod, "_PAIR_KEYS_PER_LAUNCH",
+                        float(table.n_rows))  # 1 pair per launch
+    chunked = freq_mod.compute_freq_stats(table, attrs, pairs)
+
+    for x, y in pairs:
+        np.testing.assert_array_equal(whole.pair(x, y), chunked.pair(x, y))
+    for a in attrs:
+        np.testing.assert_array_equal(whole.single(a), chunked.single(a))
+
+
+def test_pair_distinct_counter_chunked_warm(monkeypatch):
+    """A tiny per-launch budget must not change warmed distinct counts."""
+    import delphi_tpu.ops.freq as freq_mod
+    from delphi_tpu.table import encode_table
+
+    rng = np.random.RandomState(3)
+    df = pd.DataFrame({
+        "tid": np.arange(1 << 15),
+        "a": rng.randint(0, 7, 1 << 15).astype(str),
+        "b": rng.randint(0, 5, 1 << 15).astype(str),
+        "c": rng.randint(0, 3, 1 << 15).astype(str),
+    })
+    table = encode_table(df, "tid")
+    pairs = [("a", "b"), ("b", "c"), ("a", "c")]
+
+    baseline = freq_mod.PairDistinctCounter(table)
+    expect = {p: baseline.distinct_pair_count(*p) for p in pairs}
+
+    monkeypatch.setattr(freq_mod, "_PAIR_KEYS_PER_LAUNCH",
+                        float(table.n_rows))
+    warmed = freq_mod.PairDistinctCounter(table)
+    warmed.warm(pairs)
+    assert {p: warmed.distinct_pair_count(*p) for p in pairs} == expect
