@@ -416,7 +416,7 @@ class RepairModel:
         ]
         return error_cells_df[keep].reset_index(drop=True), repaired_cells_df
 
-    def _repair_by_nearest_values(self, repair_base_df: pd.DataFrame,
+    def _repair_by_nearest_values(self, masked: EncodedTable,
                                   error_cells_df: pd.DataFrame,
                                   target_columns: List[str],
                                   integral_columns: Set[str]) \
@@ -429,13 +429,15 @@ class RepairModel:
             return error_cells_df, self._empty_repaired_cells_frame()
 
         merge_threshold = self._get_option_value(*self._opt_merge_threshold)
-        # Integral attrs must stringify as ints ('100', not '100.0' from the
-        # NULL-padded float view) so distances match their current_value form.
-        domains = {
-            c: [str(int(v)) if c in integral_columns else str(v)
-                for v in repair_base_df[c].dropna().unique()]
-            for c in targets
-        }
+        # Per-target domain = the vocab entries still present after masking.
+        # Vocab spellings already match the distance space: str(int(v)) for
+        # integral attrs ('100', not the NULL-padded float view's '100.0'),
+        # str(float(v)) for fractional, raw strings otherwise (encode_column).
+        domains: Dict[str, List[str]] = {}
+        for c in targets:
+            col = masked.column(c)
+            present = np.unique(col.codes[col.codes >= 0])
+            domains[c] = [str(v) for v in col.vocab[present]]
 
         # One nearest-value resolution per unique (attribute, current value):
         # every duplicate dirty cell reuses it, and each resolution is one
@@ -477,7 +479,7 @@ class RepairModel:
             return scored[0][1]
         return None
 
-    def _repair_by_rules(self, repair_base_df: pd.DataFrame,
+    def _repair_by_rules(self, masked: EncodedTable,
                          error_cells_df: pd.DataFrame, target_columns: List[str],
                          integral_columns: Set[str]) \
             -> Tuple[pd.DataFrame, pd.DataFrame]:
@@ -487,7 +489,7 @@ class RepairModel:
             repaired_dfs.append(by_regex)
         if self._repair_by_nearest_values_enabled:
             error_cells_df, by_nv = self._repair_by_nearest_values(
-                repair_base_df, error_cells_df, target_columns, integral_columns)
+                masked, error_cells_df, target_columns, integral_columns)
             repaired_dfs.append(by_nv)
         repaired_by_rules = pd.concat(repaired_dfs, ignore_index=True)
         return error_cells_df, repaired_by_rules
@@ -544,7 +546,7 @@ class RepairModel:
             return [OrdinalEncoder(features, continuous_columns)]
         return [FeatureEncoder(features, continuous_columns)]
 
-    def _get_functional_deps(self, train_df: pd.DataFrame,
+    def _get_functional_deps(self, column_names: List[str],
                              target_columns: List[str]) \
             -> Optional[Dict[str, List[str]]]:
         constraint_detectors = [d for d in self.error_detectors
@@ -554,26 +556,35 @@ class RepairModel:
             constraint_targets = [c for c in target_columns if c in ced.targets] \
                 if ced.targets else target_columns
             return compute_functional_deps(
-                train_df, ced.constraint_path, ced.constraints, constraint_targets)
+                pd.DataFrame(columns=column_names), ced.constraint_path,
+                ced.constraints, constraint_targets)
         elif len(constraint_detectors) > 1:
             _logger.warning(
                 "Multiple constraint classes not supported for detecting functional deps")
             return None
         return None
 
-    def _sample_training_data_from(self, df: pd.DataFrame,
-                                   training_data_num: int) -> pd.DataFrame:
+    def _sample_training_positions(self, positions: np.ndarray) -> np.ndarray:
+        """Downsamples the candidate row positions to the training-row cap.
+
+        `pd.Series(positions).sample(...)` makes the same positional draw the
+        old full-frame `df.sample(...)` made (pandas samples on axis length
+        alone), so the selected rows — and their order — are identical to
+        sampling the materialized frame."""
+        training_data_num = len(positions)
         max_rows = int(self._get_option_value(*self._opt_max_training_row_num))
         if training_data_num > max_rows:
             ratio = float(max_rows) / training_data_num
             _logger.info(
                 f"To reduce training data, extracts {ratio * 100.0}% samples "
                 f"from {training_data_num} rows")
-            return df.sample(frac=ratio, random_state=42)
-        return df
+            return pd.Series(positions).sample(
+                frac=ratio, random_state=42).to_numpy()
+        return positions
 
     def _build_repair_stat_models(
-            self, models: Dict[str, Any], train_df: pd.DataFrame,
+            self, models: Dict[str, Any], masked: EncodedTable,
+            float_cols: Tuple[str, ...],
             target_columns: List[str], continuous_columns: List[str],
             num_class_map: Dict[str, int],
             feature_map: Dict[str, List[str]],
@@ -581,11 +592,13 @@ class RepairModel:
         """Builds the remaining per-attribute stat models. The reference's
         parallel pandas-UDF fan-out (model.py:817-926) is unnecessary here:
         each jitted trainer already saturates the device, so both the 'series'
-        and 'parallel' settings take this path."""
+        and 'parallel' settings take this path. Training rows decode lazily:
+        only the (capped) per-target sample ever materializes to pandas."""
         for y in [c for c in target_columns if c not in models]:
             index = len(models) + 1
-            df = train_df[train_df[y].notna()]
-            training_data_num = len(df)
+            y_codes = masked.column(y).codes
+            valid_pos = np.flatnonzero(y_codes >= 0)
+            training_data_num = len(valid_pos)
             if training_data_num == 0:
                 _logger.info(
                     "Skipping {}/{} model... type=classfier y={} num_class={}".format(
@@ -593,7 +606,10 @@ class RepairModel:
                 models[y] = (PoorModel(None), feature_map[y], None)
                 continue
 
-            train_pdf = self._sample_training_data_from(df, training_data_num)
+            sel_pos = self._sample_training_positions(valid_pos)
+            train_pdf = masked.to_pandas(
+                rows=sel_pos, columns=list(feature_map[y]) + [y],
+                integral_as_float=float_cols)
             is_discrete = y not in continuous_columns
             model_type = "classfier" if is_discrete else "regressor"
 
@@ -648,16 +664,21 @@ class RepairModel:
         return pred_ordered_models
 
     @job_phase(name="repair model training")
-    def _build_repair_models(self, train_df: pd.DataFrame, target_columns: List[str],
+    def _build_repair_models(self, masked: EncodedTable,
+                             float_cols: Tuple[str, ...],
+                             target_columns: List[str],
                              continuous_columns: List[str],
                              domain_stats: Dict[str, Any],
                              pairwise_attr_stats: Dict[str, Any]) -> List[Any]:
         # SCARE-style (see reference model.py:959-984): train per-attribute
         # conditional models P(e_y | clean attrs) on rows whose y is clean;
         # FD rules substitute for training where a clean attribute determines y.
-        train_df = train_df.drop(columns=[self._row_id])
+        # Works off the encoded int32 table: class counts and NULL masks come
+        # from the code arrays, and only FD inputs + capped training samples
+        # ever decode to pandas.
+        train_columns = masked.column_names
 
-        functional_deps = self._get_functional_deps(train_df, target_columns) \
+        functional_deps = self._get_functional_deps(train_columns, target_columns) \
             if self._repair_by_functional_deps_enabled else None
         if functional_deps:
             _logger.info(f"Functional deps found: {functional_deps}")
@@ -671,16 +692,20 @@ class RepairModel:
 
         for y in target_columns:
             index = len(models) + 1
-            input_columns = [c for c in train_df.columns if c != y]
+            input_columns = [c for c in train_columns if c != y]
             is_discrete = y not in continuous_columns
-            num_class_map[y] = int(train_df[y].nunique(dropna=True)) if is_discrete else 0
+            y_col = masked.column(y)
+            y_valid = y_col.codes >= 0
+            num_class_map[y] = int(len(np.unique(y_col.codes[y_valid]))) \
+                if is_discrete else 0
 
             if is_discrete and num_class_map[y] <= 1:
                 _logger.info(
                     "Skipping {}/{} model... type=rule y={} num_class={}".format(
                         index, len(target_columns), y, num_class_map[y]))
-                non_null = train_df[y].dropna()
-                v = non_null.iloc[0] if num_class_map[y] == 1 and len(non_null) else None
+                v = None
+                if num_class_map[y] == 1 and bool(y_valid.any()):
+                    v = y_col.vocab[y_col.codes[int(np.argmax(y_valid))]]
                 models[y] = (PoorModel(v), input_columns, None)
 
             if y not in models and functional_deps is not None and y in functional_deps:
@@ -688,14 +713,16 @@ class RepairModel:
                 fx = [x for x in functional_deps[y]
                       if int(domain_stats[x]) < max_domain]
                 if len(fx) > 0:
-                    fd_map = compute_functional_dep_map(train_df, fx[0], y)
+                    fd_frame = masked.to_pandas(
+                        columns=[fx[0], y], integral_as_float=float_cols)
+                    fd_map = compute_functional_dep_map(fd_frame, fx[0], y)
                     # Coverage guard (improvement over the reference, whose
                     # FunctionalDepModel returns None — an unrepairable cell —
                     # for every x value absent from the map, model.py:86-87):
                     # when masking left too many x groups without a surviving
                     # y (so the map covers few rows), a trained stat model
                     # repairs those cells instead of giving up on them.
-                    x_vals = train_df[fx[0]].dropna().astype(str)
+                    x_vals = fd_frame[fx[0]].dropna().astype(str)
                     coverage = float(x_vals.isin(fd_map.keys()).mean()) \
                         if len(x_vals) else 0.0
                     if coverage >= 0.8:
@@ -714,7 +741,7 @@ class RepairModel:
             feature_map: Dict[str, List[str]] = {}
             transformer_map: Dict[str, List[Any]] = {}
             for y in [c for c in target_columns if c not in models]:
-                input_columns = [c for c in train_df.columns if c != y]
+                input_columns = [c for c in train_columns if c != y]
                 features = self._select_features(pairwise_attr_stats, y, input_columns)
                 feature_map[y] = features
                 transformer_map[y] = self._create_transformers(
@@ -722,7 +749,7 @@ class RepairModel:
                     is_discrete=y not in continuous_columns,
                     num_class=num_class_map[y])
             models = self._build_repair_stat_models(
-                models, train_df, target_columns, continuous_columns,
+                models, masked, float_cols, target_columns, continuous_columns,
                 num_class_map, feature_map, transformer_map)
 
         assert len(models) == len(target_columns)
@@ -901,8 +928,13 @@ class RepairModel:
                             error_cells_df: pd.DataFrame,
                             continuous_columns: List[str]) -> pd.DataFrame:
         """PMF extraction + cost weighting + top-k filtering
-        (reference model.py:1174-1225), vectorized per attribute."""
-        flat = self._flatten(repaired_rows_df)
+        (reference model.py:1174-1225), vectorized per attribute. Only the
+        attributes that carry error cells flatten — the inner join discards
+        every other column's cells anyway."""
+        error_attrs = set(error_cells_df["attribute"].unique())
+        flat = self._flatten(repaired_rows_df[
+            [self._row_id]
+            + [c for c in repaired_rows_df.columns if c in error_attrs]])
         keys = error_cells_df[[self._row_id, "attribute", "current_value"]]
         joined = flat.merge(keys, on=[self._row_id, "attribute"], how="inner")
 
@@ -1027,26 +1059,39 @@ class RepairModel:
         path = self._get_option_value(*self._opt_checkpoint_path)
         return os.path.join(path, "repair_models.pkl") if path else ""
 
-    def _checkpoint_fingerprint(self, train_df: pd.DataFrame,
+    def _checkpoint_fingerprint(self, masked: EncodedTable,
                                 target_columns: List[str]) -> Dict[str, Any]:
         """Identity of a trained-model set: the input table name, its shape
         and schema, a cheap content hash, and every model.* option. A
         checkpoint is only reused when all of these match, so a different
         table (or the same table with edited rows/options) retrains."""
-        # hash the columns in their native dtypes — astype(str) would copy
-        # the whole table just to fingerprint it, an O(n) string
-        # materialization that matters at the 1e8-row north star
-        content = hashlib.sha1(
-            pd.util.hash_pandas_object(
-                train_df, index=False).values.tobytes()).hexdigest()
+        # Content hash over the encoded table: full vocabularies (new/renamed
+        # values always flip it) plus a bounded stride sample of each code
+        # column, so validation stays ~O(1) at the 1e8-row north star. A
+        # single-cell edit off the sample lattice that reuses existing vocab
+        # entries can slip past the sampled hash; DELPHI_CHECKPOINT_FULL_HASH=1
+        # opts into hashing every code row.
+        full = os.environ.get("DELPHI_CHECKPOINT_FULL_HASH") == "1"
+        stride = 1 if full else max(1, masked.n_rows // 65536)
+        h = hashlib.sha1()
+        h.update(b"full" if full else b"sampled")
+        h.update(np.int64(masked.n_rows).tobytes())
+        for c in masked.columns:
+            h.update(c.name.encode("utf-8", "replace"))
+            h.update("\x00".join(str(v) for v in c.vocab).encode(
+                "utf-8", "replace"))
+            h.update(np.ascontiguousarray(c.codes[::stride]).tobytes())
+            if masked.n_rows:
+                h.update(np.ascontiguousarray(c.codes[-1:]).tobytes())
+        content = h.hexdigest()
         return {
-            "version": 3,
+            "version": 4,
             "input": self._session.qualified_name(
                 self.db_name,
                 self.input if isinstance(self.input, str) else "<dataframe>"),
             "targets": sorted(target_columns),
-            "columns": list(train_df.columns),
-            "n_rows": int(len(train_df)),
+            "columns": [self._row_id] + masked.column_names,
+            "n_rows": int(masked.n_rows),
             "content_sha1": content,
             # Every expert option is part of the identity: error.* knobs shape
             # the stats that feed feature selection, model.* shape training.
@@ -1136,31 +1181,43 @@ class RepairModel:
         #######################################################################
         # 2. Repair Model Training Phase
         #######################################################################
+        # The table never materializes to pandas here (the reference masks via
+        # views without materializing either, RepairApi.scala:171-211): phases
+        # 2-3 run off the encoded int32 table, decoding only the sampled
+        # training rows and the dirty-row block. This is what keeps the
+        # 1e8-row single-host run inside memory.
         masked = table.with_nulls_at(
             list(zip(error_cells_df[ROW_IDX].astype(int), error_cells_df["attribute"])))
-        repair_base_df = masked.to_pandas()
+        # dtype snapshot: an integral column that carries NULLs after masking
+        # decodes to float64 in every downstream frame, even if rule repairs
+        # later fill all of its NULLs (the old full-frame decode fixed dtypes
+        # at this point, and subset decodes must agree with it)
+        float_cols = tuple(
+            c.name for c in masked.columns
+            if c.kind == KIND_INTEGRAL and c.numeric is not None
+            and bool(np.isnan(c.numeric).any()))
 
         repaired_by_rules_df = None
         if self.repair_by_rules:
             integral_columns = {
                 c.name for c in table.columns if c.kind == KIND_INTEGRAL}
             error_cells_df, repaired_by_rules_df = self._repair_by_rules(
-                repair_base_df, error_cells_df, target_columns, integral_columns)
-            repair_base_df = repair_attrs_from(
-                repaired_by_rules_df, repair_base_df, self._row_id,
-                self._continuous_kind_map(table))
+                masked, error_cells_df, target_columns, integral_columns)
+            if len(repaired_by_rules_df):
+                masked = masked.with_updates(list(zip(
+                    repaired_by_rules_df[ROW_IDX].astype(int),
+                    repaired_by_rules_df["attribute"],
+                    repaired_by_rules_df["repaired"])))
 
-        error_row_ids = set(error_cells_df[self._row_id])
-        is_dirty = repair_base_df[self._row_id].isin(error_row_ids)
-        clean_rows_df = repair_base_df[~is_dirty]
-        dirty_rows_df = repair_base_df[is_dirty]
+        error_row_pos = np.unique(
+            error_cells_df[ROW_IDX].to_numpy().astype(np.int64))
 
-        fingerprint = self._checkpoint_fingerprint(repair_base_df, target_columns) \
+        fingerprint = self._checkpoint_fingerprint(masked, target_columns) \
             if self._checkpoint_file() else {}
         models = self._load_model_checkpoint(fingerprint) if fingerprint else None
         if models is None:
             models = self._build_repair_models(
-                repair_base_df, target_columns, continuous_columns,
+                masked, float_cols, target_columns, continuous_columns,
                 domain_stats, pairwise_attr_stats)
             if fingerprint:
                 self._save_model_checkpoint(models, fingerprint)
@@ -1168,6 +1225,27 @@ class RepairModel:
         #######################################################################
         # 3. Repair Phase
         #######################################################################
+        need_pmf = compute_repair_candidate_prob or maximal_likelihood_repair
+        chunk_rows = int(os.environ.get("DELPHI_REPAIR_CHUNK_ROWS", "2000000"))
+        if not (need_pmf or repair_data or self.repair_validation_enabled
+                or self.repair_by_rules) \
+                and chunk_rows > 0 and len(error_row_pos) > chunk_rows:
+            # candidates-only at scale: decode + repair + extract per chunk of
+            # dirty rows so no full dirty block ever materializes at once
+            parts = []
+            for start in range(0, len(error_row_pos), chunk_rows):
+                pos = error_row_pos[start:start + chunk_rows]
+                dirty_chunk = masked.to_pandas(
+                    rows=pos, integral_as_float=float_cols)
+                repaired_chunk = self._repair(
+                    models, continuous_columns, dirty_chunk, error_cells_df,
+                    compute_repair_candidate_prob, maximal_likelihood_repair)
+                parts.append(self._extract_repair_candidates(
+                    repaired_chunk, error_cells_df, target_columns))
+            return pd.concat(parts, ignore_index=True)
+
+        dirty_rows_df = masked.to_pandas(
+            rows=error_row_pos, integral_as_float=float_cols)
         repaired_rows_df = self._repair(
             models, continuous_columns, dirty_rows_df, error_cells_df,
             compute_repair_candidate_prob, maximal_likelihood_repair)
@@ -1208,11 +1286,45 @@ class RepairModel:
                 top_delta_repairs_df, dirty_rows_df, table)
 
         if repair_data:
+            clean_pos = np.setdiff1d(
+                np.arange(table.n_rows, dtype=np.int64), error_row_pos,
+                assume_unique=True)
+            clean_rows_df = masked.to_pandas(
+                rows=clean_pos, integral_as_float=float_cols)
             clean_df = pd.concat([clean_rows_df, repaired_rows_df], ignore_index=True)
             assert len(clean_df) == table.n_rows
             return clean_df
 
-        flat = self._flatten(repaired_rows_df)
+        repair_candidates_df = self._extract_repair_candidates(
+            repaired_rows_df, error_cells_df, target_columns)
+
+        if self.repair_by_rules and repaired_by_rules_df is not None \
+                and len(repaired_by_rules_df):
+            extra = repaired_by_rules_df[
+                [self._row_id, "attribute", "current_value", "repaired"]]
+            repair_candidates_df = pd.concat(
+                [repair_candidates_df, extra], ignore_index=True)
+        if self.repair_validation_enabled:
+            clean_pos = np.setdiff1d(
+                np.arange(table.n_rows, dtype=np.int64), error_row_pos,
+                assume_unique=True)
+            clean_rows_df = masked.to_pandas(
+                rows=clean_pos, integral_as_float=float_cols)
+            repair_candidates_df = self._validate_repairs(
+                repair_candidates_df, clean_rows_df)
+        return repair_candidates_df
+
+    def _extract_repair_candidates(self, repaired_rows_df: pd.DataFrame,
+                                   error_cells_df: pd.DataFrame,
+                                   target_columns: List[str]) -> pd.DataFrame:
+        """Result shaping for the candidates path: the long view of the
+        repaired dirty block inner-joined to the error cells, keeping repairs
+        that changed the value or stayed NULL (reference model.py:1391-1408).
+        Only target columns flatten — error cells live nowhere else, so the
+        join output is identical and the long view shrinks by attrs/targets."""
+        flatten_cols = [self._row_id] + [
+            c for c in repaired_rows_df.columns if c in set(target_columns)]
+        flat = self._flatten(repaired_rows_df[flatten_cols])
         repair_candidates_df = flat.merge(
             error_cells_df[[self._row_id, "attribute", "current_value"]],
             on=[self._row_id, "attribute"], how="inner") \
@@ -1227,18 +1339,7 @@ class RepairModel:
             _is_null(r) or not _null_safe_eq(c, r)
             for c, r in zip(repair_candidates_df["current_value"],
                             repair_candidates_df["repaired"])]
-        repair_candidates_df = repair_candidates_df[changed].reset_index(drop=True)
-
-        if self.repair_by_rules and repaired_by_rules_df is not None \
-                and len(repaired_by_rules_df):
-            extra = repaired_by_rules_df[
-                [self._row_id, "attribute", "current_value", "repaired"]]
-            repair_candidates_df = pd.concat(
-                [repair_candidates_df, extra], ignore_index=True)
-        if self.repair_validation_enabled:
-            repair_candidates_df = self._validate_repairs(
-                repair_candidates_df, clean_rows_df)
-        return repair_candidates_df
+        return repair_candidates_df[changed].reset_index(drop=True)
 
     def _check_input_table(self) -> Tuple[EncodedTable, str, List[str]]:
         if isinstance(self.input, str):

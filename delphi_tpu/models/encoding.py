@@ -20,9 +20,12 @@ def _vocab_codes(series: pd.Series, vocab: Dict[str, int],
     over the DISTINCT raw values: one C-speed factorize pass plus a
     vocab-sized Python loop instead of a per-row lambda. Distinct raw
     values sharing a string form hit the same vocab entry, exactly like
-    the per-row ``str(v)`` lookup."""
+    the per-row ``str(v)`` lookup (with -0.0 folded into +0.0 so the probe
+    string matches the encode-side normalization in table.py)."""
+    from delphi_tpu.table import normalize_neg_zero
     try:
-        codes, uniques = pd.factorize(series.to_numpy(), use_na_sentinel=True)
+        codes, uniques = pd.factorize(normalize_neg_zero(series.to_numpy()),
+                                      use_na_sentinel=True)
     except TypeError:
         # unhashable cell values (e.g. ad-hoc object columns) — per-row path
         return series.map(

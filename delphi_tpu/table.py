@@ -50,18 +50,30 @@ def column_kind(series: pd.Series) -> str:
         f"unsupported ones found: {dt}")
 
 
+def normalize_neg_zero(values: np.ndarray) -> np.ndarray:
+    """Folds -0.0 into +0.0 in float arrays. Hash-based paths (factorize,
+    nunique) already treat the two as one value; normalizing before encoding
+    pins the SPELLING to '0.0' regardless of which appeared first, instead of
+    letting a leading -0.0 name the merged vocab entry '-0.0'."""
+    if values.dtype.kind == "f":
+        return np.where(values == 0.0, 0.0, values)
+    return values
+
+
 def _value_strings(series: pd.Series, kind: str) -> np.ndarray:
     """String representation of values, matching SQL CAST(x AS STRING).
 
     Formats via the DISTINCT values (factorize, then ``str()`` each unique)
     so the per-cell cost is a C-speed hash pass instead of a Python lambda
-    per row — ``str(int)`` / ``str(float)`` are injective on the raw values,
-    so first-appearance order and the produced strings are identical to the
+    per row — ``str(int)`` / ``str(float)`` are injective on the raw values
+    (after -0.0 normalization, see ``normalize_neg_zero``), so
+    first-appearance order and the produced strings are identical to the
     per-row path. Plain-string columns pass through with only NULL masking;
     object columns holding non-str values keep the exact per-row ``str()``
     semantics (distinct objects with equal string forms must still merge)."""
     if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
-        codes, uniques = pd.factorize(series.to_numpy(), use_na_sentinel=True)
+        codes, uniques = pd.factorize(normalize_neg_zero(series.to_numpy()),
+                                      use_na_sentinel=True)
         cast = (lambda v: str(int(v))) if kind == KIND_INTEGRAL \
             else (lambda v: str(float(v)))
         lut = np.array([cast(v) for v in uniques], dtype=object)
@@ -74,7 +86,23 @@ def _value_strings(series: pd.Series, kind: str) -> np.ndarray:
         # to_numpy copies when it applies na_value, so the source series'
         # buffer is never mutated
         return series.to_numpy(dtype=object, na_value=None)
-    return series.map(lambda v: str(v) if pd.notna(v) else None).to_numpy(dtype=object)
+    return series.map(_cast_object_value).to_numpy(dtype=object)
+
+
+def _cast_object_value(v: Any) -> Optional[str]:
+    """SQL CAST(x AS STRING) for a boxed value in an object column: numerics
+    widen through int/float (np.float32(0.1) spells as the double
+    '0.10000000149011612', not '0.1'), matching what the value would have
+    spelled in a properly typed column."""
+    if pd.isna(v):
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return str(int(v))
+    if isinstance(v, (int, np.integer)):
+        return str(int(v))
+    if isinstance(v, (float, np.floating)):
+        return str(float(v))
+    return str(v)
 
 
 @dataclass
@@ -104,11 +132,16 @@ class EncodedColumn:
     def null_mask(self) -> np.ndarray:
         return self.codes == NULL_CODE
 
-    def decode(self) -> np.ndarray:
-        """Back to an object array of value strings (None for NULL)."""
-        out = np.empty(len(self.codes), dtype=object)
-        valid = self.codes >= 0
-        out[valid] = self.vocab[self.codes[valid]]
+    def decode(self, rows: Optional[np.ndarray] = None) -> np.ndarray:
+        """Back to an object array of value strings (None for NULL).
+
+        ``rows`` selects a positional subset (in the given order) without
+        materializing the full column — the backbone of the phase-2/3
+        "decode only what you train on / repair" path."""
+        codes = self.codes if rows is None else self.codes[rows]
+        out = np.empty(len(codes), dtype=object)
+        valid = codes >= 0
+        out[valid] = self.vocab[codes[valid]]
         out[~valid] = None
         return out
 
@@ -126,7 +159,7 @@ def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColum
     """
     kind = column_kind(series)
     if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
-        codes, raw_uniques = pd.factorize(series.to_numpy(),
+        codes, raw_uniques = pd.factorize(normalize_neg_zero(series.to_numpy()),
                                           use_na_sentinel=True)
         cast = (lambda v: str(int(v))) if kind == KIND_INTEGRAL \
             else (lambda v: str(float(v)))
@@ -146,7 +179,8 @@ def encode_column(series: pd.Series, name: Optional[str] = None) -> EncodedColum
         vocab=np.asarray(uniques, dtype=object),
     )
     if kind in (KIND_INTEGRAL, KIND_FRACTIONAL):
-        col.numeric = pd.to_numeric(series, errors="coerce").to_numpy(dtype=np.float64)
+        col.numeric = normalize_neg_zero(
+            pd.to_numeric(series, errors="coerce").to_numpy(dtype=np.float64))
     return col
 
 
@@ -200,19 +234,93 @@ class EncodedTable:
     def row_index(self) -> Dict[object, int]:
         return {rid: i for i, rid in enumerate(self.row_id_values.tolist())}
 
-    def to_pandas(self) -> pd.DataFrame:
-        """Decode to a pandas frame with original dtypes (numeric restored)."""
-        data: Dict[str, object] = {self.row_id: self.row_id_values}
-        for c in self.columns:
+    def to_pandas(self, rows: Optional[np.ndarray] = None,
+                  columns: Optional[Sequence[str]] = None,
+                  integral_as_float: Optional[Sequence[str]] = None) -> pd.DataFrame:
+        """Decode to a pandas frame with original dtypes (numeric restored).
+
+        ``rows`` (positional, order-preserving) and ``columns`` decode only a
+        subset. Dtype restoration is decided on the FULL column — an integral
+        column decodes to int64 only when the whole column is NaN-free — so a
+        subset frame carries the same dtypes the full decode would, however
+        the subset happens to look. ``integral_as_float``, when given (even
+        empty), is the caller's COMPLETE float-forcing decision — integral
+        columns named in it decode as float64, the rest as int64 with no
+        per-call NaN re-scan. Callers that snapshot dtypes once and then
+        decode many subsets (phase 2-3 training samples, chunked repair)
+        compute it up front; passing None falls back to scanning each
+        integral column for NaNs here."""
+        data: Dict[str, object] = {
+            self.row_id: self.row_id_values if rows is None
+            else self.row_id_values[rows]}
+        force_float = None if integral_as_float is None \
+            else set(integral_as_float)
+        cols = self.columns if columns is None \
+            else [self.column(n) for n in columns]
+        for c in cols:
             if c.is_numeric:
                 assert c.numeric is not None
-                if c.kind == KIND_INTEGRAL and not np.isnan(c.numeric).any():
-                    data[c.name] = c.numeric.astype(np.int64)
+                numeric = c.numeric if rows is None else c.numeric[rows]
+                as_int = c.kind == KIND_INTEGRAL and (
+                    c.name not in force_float if force_float is not None
+                    else not np.isnan(c.numeric).any())
+                if as_int:
+                    data[c.name] = numeric.astype(np.int64)
                 else:
-                    data[c.name] = c.numeric
+                    data[c.name] = numeric
             else:
-                data[c.name] = c.decode()
+                data[c.name] = c.decode(rows)
         return pd.DataFrame(data)
+
+    def with_updates(self, cells: Sequence[Tuple[int, str, Any]]) -> "EncodedTable":
+        """Returns a copy with (row_index, attribute, value) cells updated —
+        the encoded-tensor equivalent of applying rule repairs with
+        `repairAttrsFrom` (RepairMiscApi.scala:184-247): continuous columns
+        cast the repaired string to float (integral: rounded), and novel
+        values extend the column vocab."""
+        by_attr: Dict[str, List[Tuple[int, Any]]] = {}
+        for row, attr, value in cells:
+            by_attr.setdefault(attr, []).append((row, value))
+        new_columns = []
+        for c in self.columns:
+            if c.name not in by_attr:
+                new_columns.append(c)
+                continue
+            updates = by_attr[c.name]
+            codes = c.codes.copy()
+            numeric = c.numeric.copy() if c.numeric is not None else None
+            vocab_index = {v: i for i, v in enumerate(c.vocab.tolist())}
+            vocab_list = c.vocab.tolist()
+            for row, value in updates:
+                if value is None or (not isinstance(value, (list, dict))
+                                     and pd.isna(value)):
+                    codes[row] = NULL_CODE
+                    if numeric is not None:
+                        numeric[row] = np.nan
+                    continue
+                if c.kind == KIND_INTEGRAL:
+                    num = float(np.round(float(value)))
+                    if num == 0.0:
+                        num = 0.0  # fold -0.0 (round(-0.4)) into +0.0
+                    s = str(int(num))
+                elif c.kind == KIND_FRACTIONAL:
+                    num = float(value)
+                    if num == 0.0:
+                        num = 0.0  # same -0.0 fold as normalize_neg_zero
+                    s = str(num)
+                else:
+                    num = None
+                    s = str(value)
+                if s not in vocab_index:
+                    vocab_index[s] = len(vocab_list)
+                    vocab_list.append(s)
+                codes[row] = vocab_index[s]
+                if numeric is not None:
+                    numeric[row] = num
+            new_columns.append(replace(
+                c, codes=codes, numeric=numeric,
+                vocab=np.asarray(vocab_list, dtype=object)))
+        return replace(self, columns=new_columns)
 
     def with_nulls_at(self, cells: Sequence[Tuple[int, str]]) -> "EncodedTable":
         """Returns a copy with the given (row_index, attribute) cells NULLed —

@@ -408,3 +408,12 @@ def test_repair_updates_roundtrip(adult, session):
     assert merged[[c for c in merged.columns if c != "tid"]].notna().all().all()
     # Sex cells with Husband/Wife relationship are deterministic
     assert (merged["Sex"] == clean["Sex"]).all()
+
+
+def test_chunked_repair_matches_unchunked(adult, session, monkeypatch):
+    # the candidates-only chunked path (DELPHI_REPAIR_CHUNK_ROWS) must produce
+    # byte-identical output to the one-shot dirty-block decode
+    expected = _build().run()
+    monkeypatch.setenv("DELPHI_REPAIR_CHUNK_ROWS", "2")
+    chunked = _build().run()
+    pd.testing.assert_frame_equal(chunked, expected)

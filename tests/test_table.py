@@ -93,3 +93,67 @@ def test_null_discretized_numeric():
     df = pd.DataFrame({"tid": [0, 1, 2], "v": [1.0, np.nan, 3.0], "s": ["a", "b", "a"]})
     disc = discretize_table(encode_table(df, "tid"), 4)
     assert disc.table.column("v").codes[1] == NULL_CODE
+
+
+def test_to_pandas_row_subset_preserves_full_column_dtypes():
+    df = pd.DataFrame({
+        "tid": [0, 1, 2, 3],
+        "i": [10, 20, 30, 40],
+        "f": [0.5, 1.5, np.nan, 3.5],
+        "s": ["a", None, "c", "d"],
+    })
+    table = encode_table(df, "tid")
+    masked = table.with_nulls_at([(0, "i")])
+    # the subset [1, 3] has no NaN in `i`, but the FULL masked column does —
+    # the subset decode must agree with what the full decode would produce
+    sub = masked.to_pandas(rows=np.array([1, 3]))
+    full = masked.to_pandas()
+    assert sub["i"].dtype == full["i"].dtype == np.float64
+    assert sub["i"].tolist() == [20.0, 40.0]
+    assert pd.isna(sub["s"].iloc[0]) and sub["s"].iloc[1] == "d"
+    # unmasked table: int column decodes as int64, in subsets too
+    sub2 = table.to_pandas(rows=np.array([2, 0]), columns=["i", "s"])
+    assert sub2["i"].dtype == np.int64
+    assert sub2["i"].tolist() == [30, 10]  # order-preserving
+    assert list(sub2.columns) == ["tid", "i", "s"]
+    # integral_as_float pins the dtype decision made at snapshot time
+    forced = table.to_pandas(rows=np.array([0]), integral_as_float=("i",))
+    assert forced["i"].dtype == np.float64
+
+
+def test_with_updates_extends_vocab_and_casts():
+    df = pd.DataFrame({
+        "tid": [0, 1, 2],
+        "i": [10, 20, 30],
+        "f": [0.5, 1.5, 2.5],
+        "s": ["a", "b", "c"],
+    })
+    table = encode_table(df, "tid")
+    masked = table.with_nulls_at([(0, "s"), (1, "i"), (2, "f")])
+    updated = masked.with_updates([
+        (0, "s", "zebra"),          # novel value -> vocab extension
+        (1, "i", "25.6"),           # integral: float cast + round
+        (2, "f", "9.25"),
+    ])
+    s = updated.column("s")
+    assert s.vocab[s.codes[0]] == "zebra"
+    i = updated.column("i")
+    assert i.numeric is not None and i.numeric[1] == 26.0
+    assert i.vocab[i.codes[1]] == "26"
+    f = updated.column("f")
+    assert f.numeric is not None and f.numeric[2] == 9.25
+    assert f.vocab[f.codes[2]] == "9.25"
+    # masked table untouched
+    assert masked.column("s").codes[0] == NULL_CODE
+
+
+def test_negative_zero_normalizes_to_positive_spelling():
+    # -0.0 and 0.0 hash equal, so factorize merges them; the merged vocab
+    # entry must spell '0.0' even when -0.0 appears first
+    df = pd.DataFrame({"tid": [0, 1, 2], "f": [-0.0, 0.0, 1.5], "s": list("abc")})
+    table = encode_table(df, "tid")
+    f = table.column("f")
+    assert f.vocab.tolist() == ["0.0", "1.5"]
+    assert f.codes.tolist() == [0, 0, 1]
+    assert f.numeric is not None
+    assert not np.signbit(f.numeric[0])
