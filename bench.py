@@ -220,6 +220,49 @@ def flights(scale: int, profile: bool = False) -> None:
 
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
+# On-chip measurements persist here keyed by workload@scale: the axon tunnel
+# is flaky enough that a successful TPU run must outlive the run that made it,
+# so a CPU fallback at driver time can still report the latest TPU number
+# (as `last_tpu`) instead of erasing the evidence.
+TPU_RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_TPU_LATEST.json")
+
+
+def _tpu_result_key(args: argparse.Namespace) -> str:
+    return f"{args.workload}@{args.scale}"
+
+
+def _load_tpu_results() -> dict:
+    if not os.path.exists(TPU_RESULTS_PATH):
+        return {}
+    try:
+        with open(TPU_RESULTS_PATH) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception as e:
+        # never merge into (and then overwrite) a store we couldn't read:
+        # that would destroy every other workload's saved evidence
+        print(f"warning: {TPU_RESULTS_PATH} unreadable ({e}); "
+              "refusing to overwrite it", file=sys.stderr)
+        raise
+
+
+def _persist_tpu_result(args: argparse.Namespace, parsed: dict) -> None:
+    try:
+        results = _load_tpu_results()
+        entry = {k: v for k, v in parsed.items() if k != "backend_fallback"}
+        entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        results[_tpu_result_key(args)] = entry
+        # atomic replace: a kill mid-write (the flaky-tunnel environment this
+        # cache exists for) must never leave a torn store behind
+        tmp = TPU_RESULTS_PATH + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, TPU_RESULTS_PATH)
+    except Exception as e:
+        print(f"could not persist TPU result: {e}", file=sys.stderr)
+
 
 def _child_main(args: argparse.Namespace) -> None:
     if os.environ.get("DELPHI_BENCH_BACKEND") == "cpu":
@@ -346,6 +389,17 @@ def main() -> None:
                     "its result"
             if failures:
                 parsed["backend_fallback"] = failures
+            if backend == "tpu":
+                _persist_tpu_result(args, parsed)
+            else:
+                # the tunnel was down at measurement time: carry the last
+                # persisted on-chip number so the artifact keeps TPU evidence
+                try:
+                    last = _load_tpu_results().get(_tpu_result_key(args))
+                except Exception:
+                    last = None
+                if last is not None:
+                    parsed["last_tpu"] = last
             print(json.dumps(parsed))
             return
         reason = "timeout (killed)" if rc is None else f"rc={rc}"
