@@ -288,3 +288,25 @@ def test_constraint_detector_scales_to_many_rows():
         # wall-clock bound only under the opt-in perf gates: a loaded CI
         # machine must not flake the functional suite
         assert elapsed < 30, f"DC detection too slow at 200k rows: {elapsed:.1f}s"
+
+
+def test_sklearn_detector_parallel_matches_sequential():
+    # P4 (reference errors.py:229-279): above parallel_mode_threshold the
+    # per-column detectors fan out on threads; results must be identical
+    rng = np.random.RandomState(0)
+    n = 400
+    data = {"tid": range(n), "w": ["x"] * n}
+    for j in range(4):
+        col = rng.normal(0, 1, n)
+        col[j] = 100.0  # one planted outlier per column
+        data[f"v{j}"] = col
+    df = pd.DataFrame(data)
+    cont = [f"v{j}" for j in range(4)]
+    seq = _setup(LOFOutlierErrorDetector(parallel_mode_threshold=10**9), df,
+                 continuous=cont).detect()
+    par = _setup(LOFOutlierErrorDetector(parallel_mode_threshold=1,
+                                         num_parallelism=4), df,
+                 continuous=cont).detect()
+    pd.testing.assert_frame_equal(par, seq)
+    for j in range(4):
+        assert (j, f"v{j}") in _cells(par)
