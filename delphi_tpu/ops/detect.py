@@ -18,7 +18,7 @@ All detectors return row-index arrays per attribute; the Python wrappers in
 """
 
 import re
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -247,51 +247,122 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
             return np.where(np.isnan(v1) | np.isinf(bound), False, cmp)
         raise AssertionError(f"unexpected predicate sign: {p.sign}")
 
-    # General fallback: in-group pairwise evaluation of the residual
-    # conjunction (rare in practice; bounded by group sizes).
-    order2 = np.argsort(g2, kind="stable")
-    group_members: Dict[int, np.ndarray] = {}
-    start = 0
-    sg = g2[order2]
-    while start < len(sg):
-        end = start
-        while end < len(sg) and sg[end] == sg[start]:
-            end += 1
-        group_members[int(sg[start])] = order2[start:end]
-        start = end
+    if all(p.sign == "IQ" for p in rest):
+        return _all_iq_violations(table, rest, g1, g2, n)
+    return _blocked_pairwise_violations(table, rest, g1, g2, n, n_groups)
 
-    # Hoist every per-attribute array out of the pair loop: shared-dictionary
-    # codes answer EQ/IQ, comparison ranks answer LT/GT — one build per
-    # predicate instead of one per candidate pair.
+
+def _all_iq_violations(table: EncodedTable, rest: Sequence[Predicate],
+                       g1: np.ndarray, g2: np.ndarray, n: int) -> np.ndarray:
+    """k IQ residuals by inclusion-exclusion, O(2^k * n) with k tiny.
+
+    r1 violates iff some group member j has a_p2[j] != a_p1[r1] for EVERY
+    predicate p. Counting the complement directly:
+
+        |{j : all differ}| = sum over S subseteq preds of
+                             (-1)^|S| * |{j : a_p2[j] == a_p1[r1] for p in S}|
+
+    and each term is one fused-key bincount (group key + the S-attrs), so a
+    3-predicate constraint on 1e6 rows costs 4 factorize+bincount passes
+    instead of an O(n * group) Python pair loop. NULL codes participate as
+    ordinary key values, which reproduces the pairwise null-safe semantics
+    (NULL == NULL counts as a match, NULL != value as a mismatch)."""
+    import pandas as pd
+
+    pairs = [_shared_codes(table, p.left.name, p.right.name)  # type: ignore[union-attr]
+             for p in rest]
+    k = len(pairs)
+    total = np.zeros(n, dtype=np.int64)
+    base = pd.factorize(np.concatenate([g2, g1]).astype(np.int64))[0]
+    for s_bits in range(1 << k):
+        # fused key: (group, a_p2 for p in S) on the right side, evaluated at
+        # (group, a_p1 for p in S) for left rows; iterative factorization
+        # keeps the key dense so chained strides cannot overflow
+        inv = base
+        for b in range(k):
+            if s_bits >> b & 1:
+                a1, a2 = pairs[b]
+                both = np.concatenate([a2, a1]).astype(np.int64) + 1
+                stride = int(both.max(initial=-1)) + 2
+                inv = pd.factorize(inv.astype(np.int64) * stride + both)[0]
+        counts = np.bincount(inv[:n], minlength=int(inv.max()) + 1 if inv.size else 0)
+        term = counts[inv[n:]]
+        if bin(s_bits).count("1") % 2:
+            total -= term
+        else:
+            total += term
+    return total > 0
+
+
+def _segment_arange(counts: np.ndarray) -> np.ndarray:
+    """[0..c0), [0..c1), ... concatenated (the intra-segment rank array)."""
+    total = int(counts.sum())
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, counts)
+
+
+def _blocked_pairwise_violations(table: EncodedTable, rest: Sequence[Predicate],
+                                 g1: np.ndarray, g2: np.ndarray, n: int,
+                                 n_groups: int,
+                                 pair_budget: int = 1 << 23) -> np.ndarray:
+    """Mixed residual conjunctions (IQ with LT/GT, multiple order preds):
+    exact in-group pairwise evaluation, but generated and evaluated as flat
+    vectorized blocks of (left_row, right_row) pairs instead of a Python
+    loop — still worst-case O(sum of group sizes squared) like the reference
+    self-join, with bounded memory via `pair_budget`."""
+    # per-predicate arrays, one build total (shared codes for EQ/IQ,
+    # comparison ranks for LT/GT)
     pred_arrays = []
     for p in rest:
         assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
         if p.sign in ("EQ", "IQ"):
             lc, rc = _shared_codes(table, p.left.name, p.right.name)
-            pred_arrays.append((p.sign, lc, rc))
+            pred_arrays.append((p.sign, lc.astype(np.float64), rc.astype(np.float64)))
         else:
             lv = _comparable_values(table, p.left.name)
             rv = _comparable_values(table, p.right.name)
             pred_arrays.append((p.sign, lv, rv))
 
-    def pred_holds(sign: str, left: np.ndarray, right: np.ndarray,
-                   i: int, j: int) -> bool:
-        if sign == "EQ":
-            return bool(left[i] == right[j])
-        if sign == "IQ":
-            return bool(left[i] != right[j])
-        lv, rv = left[i], right[j]
-        if np.isnan(lv) or np.isnan(rv):
-            return False
-        return bool(lv < rv) if sign == "LT" else bool(lv > rv)
+    # right-side rows sorted by group; per-group segment starts
+    order2 = np.argsort(g2, kind="stable")
+    grp_count = np.bincount(g2, minlength=n_groups) if n else \
+        np.zeros(0, dtype=np.int64)
+    grp_start = np.concatenate([[0], np.cumsum(grp_count)[:-1]]) \
+        if n_groups else np.zeros(0, dtype=np.int64)
 
     out = np.zeros(n, dtype=bool)
-    for i in range(n):
-        members = group_members.get(int(g1[i]), np.empty(0, dtype=np.int64))
-        for j in members:
-            if all(pred_holds(s, lo, ro, i, int(j)) for s, lo, ro in pred_arrays):
-                out[i] = True
-                break
+    cnt_per_left = grp_count[g1] if n else np.zeros(0, dtype=np.int64)
+    cum = np.concatenate([[0], np.cumsum(cnt_per_left)])
+    block_lo = 0
+    while block_lo < n:
+        # widest left-row block whose total pair count fits the budget
+        target = cum[block_lo] + pair_budget
+        block_hi = int(np.searchsorted(cum, target, side="right")) - 1
+        block_hi = max(block_hi, block_lo + 1)
+        rows = np.arange(block_lo, block_hi)
+        counts = cnt_per_left[rows]
+        if counts.sum() == 0:
+            block_lo = block_hi
+            continue
+        pair_left = np.repeat(rows, counts)
+        intra = _segment_arange(counts)
+        pair_right = order2[grp_start[g1[pair_left]] + intra]
+        ok = np.ones(len(pair_left), dtype=bool)
+        with np.errstate(invalid="ignore"):
+            for sign, lo_a, ro_a in pred_arrays:
+                lv = lo_a[pair_left]
+                rv = ro_a[pair_right]
+                if sign == "EQ":
+                    ok &= lv == rv
+                elif sign == "IQ":
+                    ok &= lv != rv
+                elif sign == "LT":
+                    ok &= lv < rv  # NaN comparisons are False, like the
+                else:              # reference's NULL order semantics
+                    ok &= lv > rv
+        out[pair_left[ok]] = True
+        block_lo = block_hi
     return out
 
 

@@ -310,3 +310,92 @@ def test_sklearn_detector_parallel_matches_sequential():
     pd.testing.assert_frame_equal(par, seq)
     for j in range(4):
         assert (j, f"v{j}") in _cells(par)
+
+
+# --- multi-residual denial constraints (kernelized general paths) -----------
+
+def _dc_brute_force(table, preds):
+    """Per-row pairwise oracle for two-tuple constraints."""
+    from delphi_tpu.ops.detect import _comparable_values, _shared_codes
+    n = table.n_rows
+    arrays = []
+    for p in preds:
+        if p.sign in ("EQ", "IQ"):
+            arrays.append((p.sign, *_shared_codes(table, p.left.name, p.right.name)))
+        else:
+            arrays.append((p.sign,
+                           _comparable_values(table, p.left.name),
+                           _comparable_values(table, p.right.name)))
+
+    def holds(sign, left, right, i, j):
+        if sign == "EQ":
+            return bool(left[i] == right[j])
+        if sign == "IQ":
+            return bool(left[i] != right[j])
+        lv, rv = left[i], right[j]
+        if np.isnan(lv) or np.isnan(rv):
+            return False
+        return bool(lv < rv) if sign == "LT" else bool(lv > rv)
+
+    out = np.zeros(n, dtype=bool)
+    for i in range(n):
+        for j in range(n):
+            if all(holds(s, lo, ro, i, j) for s, lo, ro in arrays):
+                out[i] = True
+                break
+    return out
+
+
+@pytest.mark.parametrize("signs", [
+    ("EQ", "IQ", "IQ"),          # all-IQ residuals -> inclusion-exclusion
+    ("EQ", "IQ", "IQ", "IQ"),
+    ("EQ", "IQ", "GT"),          # mixed -> blocked pairwise
+    ("EQ", "LT", "GT"),
+    ("IQ", "IQ"),                # no EQ join key at all
+])
+def test_multi_residual_constraint_matches_brute_force(signs):
+    from delphi_tpu.constraints import AttrRef, Predicate
+    from delphi_tpu.ops.detect import _two_tuple_violations
+    from delphi_tpu.table import encode_table
+
+    rng = np.random.RandomState(7)
+    n = 120
+    df = pd.DataFrame({
+        "tid": range(n),
+        "a": rng.randint(0, 4, n).astype(str),
+        "b": np.where(rng.rand(n) < 0.15, None,
+                      rng.randint(0, 5, n).astype(str)),
+        "c": rng.randint(0, 6, n).astype(float),
+        "d": rng.randint(0, 3, n).astype(str),
+        "e": rng.randint(0, 5, n).astype(float),
+    })
+    table = encode_table(df, "tid")
+    attrs = ["a", "b", "c", "d", "e"]
+    preds = [Predicate(sign, AttrRef(attrs[i]), AttrRef(attrs[i]))
+             for i, sign in enumerate(signs)]
+    got = _two_tuple_violations(table, preds)
+    expected = _dc_brute_force(table, preds)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_multi_residual_constraint_cross_attr():
+    # residual predicates across DIFFERENT attributes (t1.b vs t2.d)
+    from delphi_tpu.constraints import AttrRef, Predicate
+    from delphi_tpu.ops.detect import _two_tuple_violations
+    from delphi_tpu.table import encode_table
+
+    rng = np.random.RandomState(3)
+    n = 80
+    df = pd.DataFrame({
+        "tid": range(n),
+        "a": rng.randint(0, 3, n).astype(str),
+        "b": rng.randint(0, 4, n).astype(str),
+        "d": rng.randint(0, 4, n).astype(str),
+        "e": rng.randint(0, 5, n).astype(float),
+    })
+    table = encode_table(df, "tid")
+    preds = [Predicate("EQ", AttrRef("a"), AttrRef("a")),
+             Predicate("IQ", AttrRef("b"), AttrRef("d")),
+             Predicate("IQ", AttrRef("d"), AttrRef("b"))]
+    got = _two_tuple_violations(table, preds)
+    np.testing.assert_array_equal(got, _dc_brute_force(table, preds))
