@@ -1,7 +1,11 @@
 """Quality-regression harness mirroring the reference's
 `python/repair/tests/test_model_perf.py` gates.
 
-These are long-running; they only execute when DELPHI_PERF_TESTS is set:
+A fast subset ALWAYS runs (the reference runs its perf suite in CI,
+SURVEY.md §4.2): two iris single-target RMSE gates and the hospital
+error-detection gate — ~1 min, so a plain `pytest tests/` fails when
+repair quality regresses. The long-running remainder only executes when
+DELPHI_PERF_TESTS is set:
 
     DELPHI_PERF_TESTS=1 python -m pytest tests/test_model_perf.py -v
 
@@ -25,9 +29,9 @@ from delphi_tpu.errors import (
 
 from conftest import BIN_TESTDATA, load_testdata
 
-pytestmark = pytest.mark.skipif(
+full_perf_only = pytest.mark.skipif(
     not os.environ.get("DELPHI_PERF_TESTS"),
-    reason="perf gates only run when DELPHI_PERF_TESTS is set")
+    reason="full perf gates only run when DELPHI_PERF_TESTS is set")
 
 CONSTRAINT_PATH = str(BIN_TESTDATA / "hospital_constraints.txt")
 
@@ -62,8 +66,10 @@ def _build(name):
 
 
 @pytest.mark.parametrize("target,ulimit", [
-    ("sepal_width", 0.2328), ("sepal_length", 0.3980),
-    ("petal_width", 0.4339), ("petal_length", 0.6787)])
+    ("sepal_width", 0.2328),                               # always-on gate
+    pytest.param("sepal_length", 0.3980, marks=full_perf_only),
+    pytest.param("petal_width", 0.4339, marks=full_perf_only),
+    ("petal_length", 0.6787)])                             # always-on gate
 def test_repair_perf_iris_target_num_1(perf_session, target, ulimit):
     clean = load_testdata("iris_clean.csv")
     rmse = _rmse(_build("iris").setTargets([target]).run(), clean)
@@ -75,6 +81,7 @@ def test_repair_perf_iris_target_num_1(perf_session, target, ulimit):
     (["sepal_length", "petal_width"], 0.3861),
     (["petal_width", "petal_length"], 0.5278),
     (["petal_length", "sepal_width"], 0.4666)])
+@full_perf_only
 def test_repair_perf_iris_target_num_2(perf_session, targets, ulimit):
     clean = load_testdata("iris_clean.csv")
     rmse = _rmse(_build("iris").setTargets(targets).run(), clean)
@@ -83,6 +90,7 @@ def test_repair_perf_iris_target_num_2(perf_session, targets, ulimit):
 
 @pytest.mark.parametrize("target,ulimit", [
     ("CRIM", 6.1344), ("RAD", 0.9903), ("TAX", 38.5595), ("LSTAT", 3.3115)])
+@full_perf_only
 def test_repair_perf_boston_target_num_1(perf_session, target, ulimit):
     clean = load_testdata("boston_clean.csv")
     rmse = _rmse(_build("boston").setTargets([target]).run(), clean)
@@ -92,6 +100,7 @@ def test_repair_perf_boston_target_num_1(perf_session, target, ulimit):
 @pytest.mark.parametrize("targets,ulimit", [
     (["CRIM", "RAD"], 3.8716), (["RAD", "TAX"], 56.9672),
     (["TAX", "LSTAT"], 26.6608), (["LSTAT", "CRIM"], 4.6492)])
+@full_perf_only
 def test_repair_perf_boston_target_num_2(perf_session, targets, ulimit):
     clean = load_testdata("boston_clean.csv")
     rmse = _rmse(_build("boston").setTargets(targets).run(), clean)
@@ -152,6 +161,7 @@ def test_error_detection_perf_hospital(perf_session):
     assert p2 > 0.95 and r2 > 0.98 and f2 > 0.96, (p2, r2, f2)
 
 
+@full_perf_only
 def test_repair_perf_hospital(perf_session):
     import Levenshtein as lev
 
