@@ -18,6 +18,8 @@ Per the reference semantics:
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import os
+
 import numpy as np
 
 from delphi_tpu.ops.freq import FreqStats
@@ -75,47 +77,99 @@ def compute_domain_in_error_cells(
             continue
 
         vocab = table.column(attr).vocab
-        v_a = len(vocab)
         single = freq.single(attr)[1:]  # [v_a], non-NULL value counts
-        # posterior contribution accumulator per (cell, candidate value)
-        score = np.zeros((len(rows), v_a), dtype=np.float64)
-        contributed = np.zeros((len(rows), v_a), dtype=bool)
+        has_single = single > 0
 
+        pair_tables = []
+        taus = []
+        corr_codes = []
         for c in corr_attrs:
             d_c = int(domain_stats[c])
             d_a = int(domain_stats[attr])
-            tau = int(alpha * (n // max(d_c * d_a, 1)))
+            taus.append(int(alpha * (n // max(d_c * d_a, 1))))
+            pair_tables.append(freq.pair(c, attr))  # [V_c + 1, V_a + 1]
+            corr_codes.append(table.column(c).codes)
 
-            pair = freq.pair(c, attr)        # [V_c + 1, V_a + 1]
-            codes_c = table.column(c).codes[rows]  # corr-attr value per cell row
-            gathered = pair[codes_c + 1][:, 1:]    # [cells, v_a]; NULL rows give slot 0
-            valid = (codes_c != NULL_CODE)[:, None]
-            active = (gathered > max(tau, 0)) & (gathered > 0) & valid
-            weights = np.where(active, np.maximum(gathered - 1.0, 0.1), 0.0)
-            # exp(ln(cnt_v/N) + ln(w/cnt_v)) == w/N, valid only when cnt_v > 0
-            has_single = single > 0
-            contrib = np.where(has_single[None, :], weights / n, 0.0)
-            score += np.where(active & has_single[None, :], contrib, 0.0)
-            contributed |= active & has_single[None, :]
+        # Cells process in bounded chunks: the [cells, v_a] score matrices are
+        # the phase's memory peak at north-star scale, and a fixed chunk also
+        # gives the mesh kernel a stable shard shape.
+        chunk = max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS", "1000000")))
+        for lo in range(0, len(rows), chunk):
+            sub_rows = rows[lo:lo + chunk]
+            codes_chunk = [c[sub_rows] for c in corr_codes]
+            prob, contributed = _score_cells(
+                codes_chunk, pair_tables, taus, has_single, n)
 
-        denom = score.sum(axis=1, keepdims=True)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            prob = np.where(denom > 0, score / denom, 0.0)
-
-        # One nonzero + lexsort over every surviving (cell, value) entry
-        # instead of a per-cell scan: Python-level work is proportional to
-        # the kept domain entries (few per cell), not cells x vocabulary.
-        keep_mask = contributed & (prob > beta)
-        cell_idx, val_idx = np.nonzero(keep_mask)
-        probs_sel = prob[cell_idx, val_idx]
-        vocab_sel = vocab[val_idx]
-        order = np.lexsort((vocab_sel, -probs_sel, cell_idx))
-        doms: List[List[Tuple[str, float]]] = [[] for _ in range(len(rows))]
-        for c, v, p in zip(cell_idx[order].tolist(),
-                           vocab_sel[order].tolist(),
-                           probs_sel[order].tolist()):
-            doms[c].append((str(v), float(p)))
-        for i, (r, cur) in enumerate(zip(rows, currents)):
-            out.append(CellDomain(int(r), attr, cur, doms[i]))
+            # One nonzero + lexsort over every surviving (cell, value) entry
+            # instead of a per-cell scan: Python-level work is proportional to
+            # the kept domain entries (few per cell), not cells x vocabulary.
+            keep_mask = contributed & (prob > beta)
+            cell_idx, val_idx = np.nonzero(keep_mask)
+            probs_sel = prob[cell_idx, val_idx]
+            vocab_sel = vocab[val_idx]
+            order = np.lexsort((vocab_sel, -probs_sel, cell_idx))
+            doms: List[List[Tuple[str, float]]] = [[] for _ in range(len(sub_rows))]
+            for ci, v, p in zip(cell_idx[order].tolist(),
+                                vocab_sel[order].tolist(),
+                                probs_sel[order].tolist()):
+                doms[ci].append((str(v), float(p)))
+            for i, r in enumerate(sub_rows):
+                cur = currents[lo + i]
+                out.append(CellDomain(int(r), attr, cur, doms[i]))
 
     return out
+
+
+def _score_cells(codes_chunk: List[np.ndarray],
+                 pair_tables: List[np.ndarray],
+                 taus: List[int],
+                 has_single: np.ndarray,
+                 n_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Naive-Bayes posterior scores for one chunk of error cells.
+
+    Returns (prob [cells, v_a], contributed [cells, v_a]). Dispatches to the
+    row-sharded mesh kernel when DELPHI_MESH is active (SURVEY.md §2.3 P1 —
+    this was one of the last single-host reductions), else runs as numpy."""
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+    # Device accumulation is int32 (no x64 on TPU): sum_k(cnt - 1) must stay
+    # under 2^31 for the mesh path's bit-identical contract to hold. The
+    # bound is loose (k * max pair count); past it, fall back to host int64.
+    max_count = max((int(t.max(initial=0)) for t in pair_tables), default=0)
+    mesh_safe = len(codes_chunk) * max(max_count, 1) < 2 ** 31
+    if mesh is not None and len(codes_chunk) and len(codes_chunk[0]) \
+            and mesh_safe:
+        from delphi_tpu.parallel.sharded import sharded_domain_scores
+        big, tiny, contributed = sharded_domain_scores(
+            codes_chunk, pair_tables, taus, has_single, mesh)
+        return _combine_scores(big, tiny, contributed, n_rows)
+
+    n_cells = len(codes_chunk[0]) if codes_chunk else 0
+    v_a = int(has_single.shape[0])
+    # Exact integer accumulators: weights are max(cnt-1, 0.1), so the score
+    # splits into big = sum(cnt-1 | cnt >= 2) and tiny = #(cnt == 1) active
+    # correlates — both integers, recombined once in float64. The mesh kernel
+    # returns the same two integers from int32 device math, which is what
+    # makes the sharded path bit-identical to this one.
+    big = np.zeros((n_cells, v_a), dtype=np.int64)
+    tiny = np.zeros((n_cells, v_a), dtype=np.int64)
+    contributed = np.zeros((n_cells, v_a), dtype=bool)
+    for codes_c, pair, tau in zip(codes_chunk, pair_tables, taus):
+        gathered = pair[codes_c + 1][:, 1:]    # [cells, v_a]; NULL rows give slot 0
+        valid = (codes_c != NULL_CODE)[:, None]
+        # exp(ln(cnt_v/N) + ln(w/cnt_v)) == w/N, valid only when cnt_v > 0
+        active = (gathered > max(tau, 0)) & (gathered > 0) & valid \
+            & has_single[None, :]
+        big += np.where(active & (gathered >= 2), gathered - 1, 0)
+        tiny += (active & (gathered == 1)).astype(np.int64)
+        contributed |= active
+    return _combine_scores(big, tiny, contributed, n_rows)
+
+
+def _combine_scores(big: np.ndarray, tiny: np.ndarray, contributed: np.ndarray,
+                    n_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    score = (big.astype(np.float64) + 0.1 * tiny.astype(np.float64)) / n_rows
+    denom = score.sum(axis=1, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prob = np.where(denom > 0, score / denom, 0.0)
+    return prob, contributed

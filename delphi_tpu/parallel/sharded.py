@@ -22,6 +22,14 @@ def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarr
     scratch slot that is dropped)."""
     dp = mesh.shape["dp"]
     padded, n = pad_rows_to_multiple(codes, dp, fill=-2)
+    return sharded_single_counts_global(shard_rows(padded, mesh), v_pad, mesh)
+
+
+def sharded_single_counts_global(global_codes, v_pad: int, mesh: Mesh) -> np.ndarray:
+    """`sharded_single_counts` over a pre-assembled global device array —
+    the entry point for sharded ingestion, where each process contributed
+    its own rows via `shard_rows_process_local` (padding rows = -2) and no
+    host ever saw the full table."""
 
     @partial(shard_map, mesh=mesh, in_specs=P("dp", None), out_specs=P())
     def kernel(local):
@@ -30,7 +38,7 @@ def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarr
         counts = jax.vmap(one, in_axes=1)(local)
         return jax.lax.psum(counts, "dp")
 
-    counts = np.asarray(kernel(shard_rows(padded, mesh)))
+    counts = np.asarray(kernel(global_codes))
     return counts[:, 1:]  # drop the padding slot
 
 
@@ -58,6 +66,55 @@ def sharded_pair_counts(codes: np.ndarray, pairs: Sequence[Tuple[int, int]],
         return jax.lax.psum(counts, "dp")
 
     return np.asarray(kernel(shard_rows(padded, mesh), xi, yi))
+
+
+def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],
+                          pair_tables: Sequence[np.ndarray],
+                          taus: Sequence[int],
+                          has_single: np.ndarray,
+                          mesh: Mesh) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cell-sharded naive-Bayes domain scoring (P1: the last heavy phase-1
+    reduction to shard). Each device gathers its cells' pair-count rows and
+    accumulates the EXACT integer split of the evidence weights — big =
+    sum(cnt - 1 | cnt >= 2), tiny = #(cnt == 1) — so the caller's float64
+    recombination is bit-identical to the single-host numpy path.
+
+    codes_chunk: per-correlate codes of the chunk cells, each int32[cells];
+    pair_tables: per-correlate [V_c + 1, v_a + 1] co-occurrence counts;
+    returns (big, tiny, contributed), each [cells, v_a]."""
+    k = len(codes_chunk)
+    cells = len(codes_chunk[0])
+    v_a = int(has_single.shape[0])
+    dp = mesh.shape["dp"]
+
+    codes = np.stack(codes_chunk, axis=1).astype(np.int32)  # [cells, k]
+    padded, _ = pad_rows_to_multiple(codes, dp, fill=-1)    # pad rows: NULL -> inactive
+    vc_max = max(int(t.shape[0]) for t in pair_tables)
+    tables = np.zeros((k, vc_max, v_a + 1), dtype=np.int32)
+    for i, t in enumerate(pair_tables):
+        tables[i, :t.shape[0], :] = t
+    taus_arr = np.asarray([max(int(t), 0) for t in taus], dtype=np.int32)
+    hs = np.asarray(has_single, dtype=bool)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp", None), P(), P(), P()),
+             out_specs=(P("dp", None), P("dp", None), P("dp", None)))
+    def kernel(local, tables, taus_arr, hs):
+        def one(codes_c, table_c, tau):
+            gathered = table_c[codes_c + 1][:, 1:]          # [cells, v_a]
+            valid = (codes_c != -1)[:, None]
+            active = (gathered > tau) & (gathered > 0) & valid & hs[None, :]
+            big = jnp.where(active & (gathered >= 2), gathered - 1, 0)
+            tiny = (active & (gathered == 1)).astype(jnp.int32)
+            return big, tiny, active
+        bigs, tinys, actives = jax.vmap(one, in_axes=(1, 0, 0))(
+            local, tables, taus_arr)
+        return (bigs.sum(axis=0), tinys.sum(axis=0), actives.any(axis=0))
+
+    big, tiny, contributed = kernel(
+        shard_rows(padded, mesh), jnp.asarray(tables), jnp.asarray(taus_arr),
+        jnp.asarray(hs))
+    return (np.asarray(big)[:cells], np.asarray(tiny)[:cells],
+            np.asarray(contributed)[:cells])
 
 
 def sharded_null_counts(codes: np.ndarray, mesh: Mesh) -> np.ndarray:

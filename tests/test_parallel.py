@@ -139,3 +139,34 @@ def test_pipeline_runs_on_mesh(adult_df, monkeypatch):
 
     pd.testing.assert_frame_equal(base, on_mesh)
     assert len(base) > 0
+
+
+def test_sharded_domain_scores_bit_identical_to_host(mesh, monkeypatch):
+    # the mesh path must reproduce the single-host path EXACTLY: both return
+    # integer (big, tiny) accumulators recombined identically in float64
+    from delphi_tpu.ops.domain import _score_cells
+    from delphi_tpu.parallel import mesh as mesh_mod
+
+    rng = np.random.RandomState(5)
+    cells, v_a, k = 203, 7, 3
+    codes_chunk = [rng.randint(-1, 6, cells).astype(np.int32) for _ in range(k)]
+    pair_tables = [rng.randint(0, 9, size=(7, v_a + 1)).astype(np.int64)
+                   for _ in range(k)]
+    taus = [0, 1, 2]
+    has_single = rng.rand(v_a) > 0.2
+    n_rows = 1000
+
+    host_prob, host_contrib = _score_cells(
+        codes_chunk, pair_tables, taus, has_single, n_rows)
+
+    monkeypatch.setenv("DELPHI_MESH", "8")
+    mesh_mod._active_mesh_cache.clear()
+    try:
+        mesh_prob, mesh_contrib = _score_cells(
+            codes_chunk, pair_tables, taus, has_single, n_rows)
+    finally:
+        monkeypatch.delenv("DELPHI_MESH")
+        mesh_mod._active_mesh_cache.clear()
+
+    np.testing.assert_array_equal(mesh_contrib, host_contrib)
+    np.testing.assert_array_equal(mesh_prob, host_prob)  # bit-exact
