@@ -55,3 +55,25 @@ def maybe_initialize_distributed() -> bool:
     return True
 
 
+def allgather_host_bytes(payload: bytes) -> list:
+    """All-gathers one opaque byte string per process (vocab unification for
+    sharded ingestion). Two rounds over the device collective: lengths first,
+    then the max-padded payloads — the multi-host analog of the driver
+    collecting every executor's dictionary."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return [payload]
+    length = np.asarray([len(payload)], dtype=np.int32)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(length)).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return [gathered[i, :int(lengths[i])].tobytes()
+            for i in range(len(lengths))]
+
+

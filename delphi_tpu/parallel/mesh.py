@@ -103,6 +103,36 @@ def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
     return jax.device_put(array, sharding)
 
 
+def shard_rows_process_local(local_rows: np.ndarray, mesh: Mesh,
+                             axis: str = "dp", fill=-2):
+    """Assembles the GLOBAL row-sharded device array from per-process local
+    row blocks (the sharded-ingestion path: no process ever holds the full
+    table). Every process pads its block to the common per-process length
+    (all-gathered max, rounded to its local device count) and contributes it
+    via `jax.make_array_from_process_local_data`; global row order is
+    process-major. Padding rows carry `fill` (-2 = the stats kernels'
+    scratch slot)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    n_local = local_rows.shape[0]
+    ld = max(1, int(mesh.local_mesh.shape[axis]))
+    if jax.process_count() > 1:
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_local], dtype=np.int64))).reshape(-1)
+        per = int(counts.max())
+    else:
+        per = n_local
+    per = ((max(per, 1) + ld - 1) // ld) * ld
+    pad = np.full((per - n_local,) + local_rows.shape[1:], fill,
+                  dtype=local_rows.dtype)
+    padded = np.concatenate([local_rows, pad], axis=0)
+    spec = P(axis, *([None] * (local_rows.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (per * jax.process_count(),) + local_rows.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, padded, global_shape)
+
+
 def padded_row_target(n: int, mesh: Optional[Mesh], axis: str = "dp") -> int:
     """Row count to pad to: the next power of two (>= 8, recompilation
     bound), raised to a multiple of the mesh's dp size so row shards are

@@ -1,0 +1,110 @@
+"""2-process `jax.distributed` CPU smoke test (SURVEY.md §2.3: the DCN-scale
+substrate): cluster init through parallel/distributed.py, sharded ingestion
+with cross-process vocabulary unification, and a psum'd stats kernel over the
+process-local global array — no process ever holds the full table."""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from conftest import TESTDATA
+
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
+os.environ["DELPHI_NUM_PROCESSES"] = "2"
+os.environ["DELPHI_PROCESS_ID"] = sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+assert maybe_initialize_distributed()
+assert jax.process_count() == 2
+
+from delphi_tpu.ingest import read_csv_encoded, read_csv_encoded_sharded
+from delphi_tpu.parallel.mesh import make_mesh, shard_rows_process_local
+from delphi_tpu.parallel.sharded import sharded_single_counts_global
+
+path = os.environ["HOSPITAL_CSV"]
+local = read_csv_encoded_sharded(path, "tid", chunksize=100)
+# each process holds only its chunk subset (1000 rows split round-robin)
+assert local.n_rows < 1000, local.n_rows
+
+# fewer chunks than processes: rank 1 gets zero rows but must still join
+# the vocabulary all-gather without crashing or hanging rank 0
+single_chunk = read_csv_encoded_sharded(path, "tid", chunksize=2000)
+if jax.process_index() == 0:
+    assert single_chunk.n_rows == 1000
+else:
+    assert single_chunk.n_rows == 0
+    assert len(single_chunk.column("City").vocab) > 0  # unified vocab arrived
+
+mesh = make_mesh(axis_names=("dp",))
+assert mesh.shape["dp"] == 2
+attrs = ["City", "State"]
+codes = local.codes(attrs)
+garr = shard_rows_process_local(codes, mesh)
+v_pad = max(len(local.column(a).vocab) for a in attrs)
+counts = sharded_single_counts_global(garr, v_pad, mesh)
+
+if jax.process_index() == 0:
+    full = read_csv_encoded(path, "tid", chunksize=100)
+    assert full.n_rows == 1000
+    for j, name in enumerate(attrs):
+        vocab = local.column(name).vocab  # globally unified
+        got = {str(v): int(c) for v, c in zip(vocab, counts[j, 1:1 + len(vocab)])}
+        col = full.column(name)
+        exp_counts = np.bincount(col.codes[col.codes >= 0],
+                                 minlength=len(col.vocab))
+        exp = {str(v): int(c) for v, c in zip(col.vocab, exp_counts)}
+        assert got == exp, f"{name}: sharded counts diverge"
+        assert int(counts[j, 0]) == int((col.codes < 0).sum())
+    print("DIST_SMOKE_OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("DELPHI_SKIP_DIST_SMOKE") == "1",
+    reason="explicitly disabled")
+def test_two_process_distributed_smoke(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "dist_worker.py"
+    worker.write_text(_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["COORD"] = f"127.0.0.1:{port}"
+    env["HOSPITAL_CSV"] = str(TESTDATA / "hospital.csv")
+    repo = str(Path(__file__).resolve().parents[1])
+    env["REPO"] = repo
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i)], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+    assert "DIST_SMOKE_OK" in outs[0]
