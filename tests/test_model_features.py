@@ -417,3 +417,20 @@ def test_chunked_repair_matches_unchunked(adult, session, monkeypatch):
     monkeypatch.setenv("DELPHI_REPAIR_CHUNK_ROWS", "2")
     chunked = _build().run()
     pd.testing.assert_frame_equal(chunked, expected)
+
+
+def test_hp_refinement_improves_or_preserves_cv(session):
+    # `model.hp.no_progress_loss` enables local refinement rounds around the
+    # winning grid config (the reference's hyperopt early-stop analog);
+    # refinement only ever accepts strict improvements
+    from delphi_tpu.train import build_model
+
+    rng = np.random.RandomState(0)
+    n = 120
+    X = rng.randn(n, 4).astype(np.float64)
+    y = pd.Series(X[:, 0] * 2.0 - X[:, 1] + 0.1 * rng.randn(n))
+    (m1, s1), _ = build_model(X, y, False, 0, n_jobs=-1, opts={})
+    (m2, s2), _ = build_model(
+        X, y, False, 0, n_jobs=-1, opts={"model.hp.no_progress_loss": "5"})
+    assert m1 is not None and m2 is not None
+    assert s2 >= s1
