@@ -399,3 +399,21 @@ def test_multi_residual_constraint_cross_attr():
              Predicate("IQ", AttrRef("d"), AttrRef("b"))]
     got = _two_tuple_violations(table, preds)
     np.testing.assert_array_equal(got, _dc_brute_force(table, preds))
+
+
+def test_gaussian_outlier_approx_percentiles():
+    # approx quartiles from a bounded sample: same obvious outliers flagged
+    rng = np.random.RandomState(1)
+    n = 150_000
+    vals = rng.normal(10, 1, n)
+    vals[-1] = 1e6
+    df = pd.DataFrame({"tid": range(n), "v": vals, "w": ["x"] * n})
+    exact = _setup(GaussianOutlierErrorDetector(approx_enabled=False), df,
+                   continuous=["v"]).detect()
+    approx = _setup(GaussianOutlierErrorDetector(approx_enabled=True), df,
+                    continuous=["v"]).detect()
+    assert (n - 1, "v") in _cells(approx)
+    # the sampled fences sit within sampling noise of exact: flag sets agree
+    # to well under 1% of rows
+    sym_diff = set(_cells(exact)) ^ set(_cells(approx))
+    assert len(sym_diff) < n * 0.01
