@@ -213,6 +213,12 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setDbName(self, db_name: str) -> "RepairModel":
+        """Sets the database prefix used to qualify ``table_name``
+        inputs (reference model.py:236-252). Incompatible with DataFrame
+        inputs.
+
+        :param db_name: database name (e.g. ``"default"``).
+        """
         if type(self.input) is pd.DataFrame:
             raise ValueError("Can not specify a database name when input is `DataFrame`")
         self.db_name = db_name
@@ -220,6 +226,11 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setTableName(self, table_name: str) -> "RepairModel":
+        """Sets the input by registered table/view name
+        (reference model.py:254-268).
+
+        :param table_name: name registered in the session catalog.
+        """
         if not table_name:
             raise ValueError("`table_name` should have at least character")
         self.input = table_name
@@ -227,6 +238,11 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setInput(self, input: Union[str, pd.DataFrame]) -> "RepairModel":
+        """Sets the input table: either a registered table/view name
+        or a pandas DataFrame (reference model.py:270-288).
+
+        :param input: table name or DataFrame holding the dirty data.
+        """
         if type(input) is str:
             self.setTableName(input)
         else:
@@ -236,6 +252,11 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setRowId(self, row_id: str) -> "RepairModel":
+        """Names the column holding the unique row identifier
+        (reference model.py:290-304). Required before ``run()``.
+
+        :param row_id: row-id column name (must be unique per row).
+        """
         if not row_id:
             raise ValueError("`row_id` should have at least character")
         self.row_id = row_id
@@ -243,6 +264,12 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setTargets(self, attrs: List[str]) -> "RepairModel":
+        """Restricts detection/repair to the given attributes
+        (reference model.py:306-320); all discretizable attributes are
+        candidates by default.
+
+        :param attrs: non-empty list of attribute names.
+        """
         if len(attrs) == 0:
             raise ValueError("`attrs` should have at least one attribute")
         self.targets = attrs
@@ -250,6 +277,13 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setErrorCells(self, error_cells: Union[str, pd.DataFrame]) -> "RepairModel":
+        """Supplies ground-truth error cells — a table/DataFrame
+        with ``(row_id, attribute)`` columns — skipping the error-detection
+        phase's detectors (reference model.py:322-352). ``setRowId`` must be
+        called first.
+
+        :param error_cells: table name or DataFrame of known error cells.
+        """
         if type(error_cells) is str and not error_cells:
             raise ValueError("`error_cells` should have at least character")
         if self.row_id is None:
@@ -264,11 +298,24 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setErrorDetectors(self, detectors: List[ErrorDetector]) -> "RepairModel":
+        """Sets the detectors that propose noisy cells in
+        phase 1 (reference model.py:354-372): ``NullErrorDetector``,
+        ``DomainValues``, ``RegExErrorDetector``, ``ConstraintErrorDetector``,
+        outlier detectors, or custom ``ScikitLearnBackedErrorDetector``.
+
+        :param detectors: list of :class:`ErrorDetector` instances.
+        """
         self.error_detectors = detectors
         return self
 
     @argtype_check  # type: ignore
     def setDiscreteThreshold(self, thres: int) -> "RepairModel":
+        """Sets the max domain size for an attribute to
+        stay discrete; continuous attributes equi-width bin into this many
+        buckets (reference model.py:374-388, RepairApi.scala:126-149).
+
+        :param thres: threshold in ``[2, 65536)`` (default 80).
+        """
         if int(thres) < 2:
             raise ValueError(f"`thres` should be bigger than 1, got {thres}")
         self.discrete_thres = thres
@@ -276,6 +323,14 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setParallelStatTrainingEnabled(self, enabled: bool) -> "RepairModel":
+        """Reference API parity for the
+        pandas-UDF training fan-out (reference model.py:383-395): here
+        per-attribute training already runs as batched device launches (and
+        shards over the mesh under ``DELPHI_MESH``), so both settings take
+        the same path.
+
+        :param enabled: accepted for compatibility.
+        """
         if enabled:
             _logger.info(
                 "setParallelStatTrainingEnabled: per-attribute training "
@@ -288,16 +343,36 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setTrainingDataRebalancingEnabled(self, enabled: bool) -> "RepairModel":
+        """Enables class rebalancing of
+        training rows toward the median class size before fitting
+        classifiers (reference model.py:397-409, train.py:242-293).
+
+        :param enabled: ``True`` to oversample/undersample per class.
+        """
         self.training_data_rebalancing_enabled = enabled
         return self
 
     @argtype_check  # type: ignore
     def setRepairByRules(self, enabled: bool) -> "RepairModel":
+        """Enables rule-based repairs before model training:
+        regex structure repair, nearest-value merging (with a cost
+        function), and functional-dependency rules (reference
+        model.py:411-427). Fine-grained control via the
+        ``model.rule.*`` options.
+
+        :param enabled: ``True`` to try rule repairs first.
+        """
         self.repair_by_rules = enabled
         return self
 
     @argtype_check  # type: ignore
     def setRepairDelta(self, delta: int) -> "RepairModel":
+        """Caps how many repairs the maximal-likelihood mode
+        keeps: the ``delta`` highest-scoring updates win (reference
+        model.py:429-443).
+
+        :param delta: positive number of updates to apply.
+        """
         if delta <= 0:
             raise ValueError(f"Repair delta should be positive, got {delta}")
         self.repair_delta = int(delta)
@@ -305,11 +380,26 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setUpdateCostFunction(self, cf: UpdateCostFunction) -> "RepairModel":
+        """Sets the cost of changing value x into y,
+        used to weight PMFs and maximal-likelihood scores (reference
+        model.py:445-462): :class:`Levenshtein` or a
+        :class:`UserDefinedUpdateCostFunction`.
+
+        :param cf: an :class:`UpdateCostFunction` instance.
+        """
         self.cf = cf
         return self
 
     @argtype_check  # type: ignore
     def option(self, key: str, value: str) -> "RepairModel":
+        """Sets one expert option by key (reference model.py:478-496),
+        validated against the registered ``model.*`` / ``error.*`` /
+        ``repair.*`` keys; invalid keys raise, invalid values warn (or
+        raise under testing).
+
+        :param key: option name (e.g. ``"model.max_training_row_num"``).
+        :param value: option value as a string.
+        """
         if key not in self.option_keys:
             raise ValueError(f"Non-existent key specified: key={key}")
         self.opts[key] = value
