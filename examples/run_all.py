@@ -1,0 +1,86 @@
+"""Runs every example end-to-end and captures its transcript next to the
+script (`examples/<name>.py.out`), mirroring the reference's committed
+`resources/examples/*.py.out` evidence files.
+
+Usage: python examples/run_all.py [--cpu] [names...]
+
+Each transcript records the example's stdout (repairs and P/R/F1 lines).
+tax.py / movies.py need datasets the reference checkout does not bundle
+(testdata/raha ships only beers/flights/rayyan); they are skipped with a
+note unless a data dir is supplied via DELPHI_RAHA_EXTRA.
+"""
+
+import argparse
+import contextlib
+import io
+import os
+import runpy
+import sys
+import time
+
+EXAMPLES_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# insertion order = cheap first
+ALL = ["adult", "iris", "boston", "error_detectors", "flights", "beers",
+       "rayyan", "hospital", "hospital_preprocess_blocking", "tax", "movies"]
+NEEDS_EXTRA_DATA = {"tax", "movies"}
+
+
+def run_one(name: str) -> str:
+    path = os.path.join(EXAMPLES_DIR, f"{name}.py")
+    out = io.StringIO()
+    t0 = time.time()
+    status = "ok"
+    old_argv = sys.argv
+    extra = os.environ.get("DELPHI_RAHA_EXTRA")
+    sys.argv = [path] + ([extra] if name in NEEDS_EXTRA_DATA and extra else [])
+    try:
+        with contextlib.redirect_stdout(out):
+            runpy.run_path(path, run_name="__main__")
+    except SystemExit as e:
+        if e.code not in (0, None):
+            status = f"exit {e.code}"
+    except Exception as e:  # noqa: BLE001 - transcript records the failure
+        status = f"error: {e.__class__.__name__}: {e}"
+    finally:
+        sys.argv = old_argv
+    elapsed = time.time() - t0
+    transcript = out.getvalue()
+    transcript += f"\n[{name}.py finished: {status}, {elapsed:.1f}s]\n"
+    with open(path + ".out", "w") as f:
+        f.write(transcript)
+    print(f"{name}: {status} ({elapsed:.1f}s)", file=sys.stderr)
+    return transcript
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("names", nargs="*", default=None)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (replicates tests/conftest)")
+    args = parser.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            import jax._src.xla_bridge as xb
+            xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+    names = args.names or ALL
+    for name in names:
+        if name in NEEDS_EXTRA_DATA and not os.environ.get("DELPHI_RAHA_EXTRA"):
+            note = (f"{name}.py: dataset not bundled in this reference "
+                    "checkout (testdata/raha ships only beers/flights/"
+                    "rayyan); set DELPHI_RAHA_EXTRA=<dir> to run it\n")
+            with open(os.path.join(EXAMPLES_DIR, f"{name}.py.out"), "w") as f:
+                f.write(note)
+            print(note.strip(), file=sys.stderr)
+            continue
+        run_one(name)
+
+
+if __name__ == "__main__":
+    main()
