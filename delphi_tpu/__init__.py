@@ -28,12 +28,25 @@ if _os.environ.get("DELPHI_XLA_CACHE", "1") != "0":
     try:
         import hashlib as _hashlib
 
-        # Scope the cache by the XLA configuration: entries AOT-compiled
-        # under different XLA_FLAGS (e.g. the 8-virtual-device test config)
-        # are not safely loadable in other configs.
+        # Scope the cache by the XLA configuration AND the host CPU: entries
+        # AOT-compiled under different XLA_FLAGS (e.g. the 8-virtual-device
+        # test config) are not safely loadable in other configs, and
+        # executables compiled on a host with different CPU features load
+        # with SIGILL risk (xla's cpu_aot_loader warns loudly), so a moved
+        # checkout starts a fresh cache instead of limping on a stale one.
+        try:
+            with open("/proc/cpuinfo") as _f:
+                _cpu = next((ln for ln in _f
+                             if ln.startswith(("flags", "Features"))), "")
+        except OSError:
+            _cpu = ""
+        if not _cpu:  # non-x86/arm cpuinfo layouts
+            import platform as _platform
+            _cpu = _platform.processor() or _platform.machine()
         _fingerprint = _hashlib.sha1(
             (_os.environ.get("XLA_FLAGS", "") + "|"
-             + _os.environ.get("JAX_PLATFORMS", "")).encode()).hexdigest()[:12]
+             + _os.environ.get("JAX_PLATFORMS", "") + "|"
+             + _cpu).encode()).hexdigest()[:12]
         _cache_dir = _os.environ.get(
             "DELPHI_XLA_CACHE_DIR",
             _os.path.join(_os.path.expanduser("~"), ".cache",
