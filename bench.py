@@ -265,6 +265,13 @@ def _persist_tpu_result(args: argparse.Namespace, parsed: dict) -> None:
 
 
 def _child_main(args: argparse.Namespace) -> None:
+    if os.environ.get("DELPHI_BENCH_LOG"):
+        # surface the pipeline's phase narration (timestamps included) so
+        # long scale runs are observable from the log file
+        import logging
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(levelname)s %(message)s", stream=sys.stderr)
     if os.environ.get("DELPHI_BENCH_BACKEND") == "cpu":
         _force_cpu_backend()
     # Initialize the backend up front and announce it, so the parent can
