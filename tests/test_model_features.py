@@ -434,3 +434,24 @@ def test_hp_refinement_improves_or_preserves_cv(session):
         X, y, False, 0, n_jobs=-1, opts={"model.hp.no_progress_loss": "5"})
     assert m1 is not None and m2 is not None
     assert s2 >= s1
+
+
+def test_phases_2_3_never_decode_the_full_table(adult, session, monkeypatch):
+    # the round-3 memory contract: after detection, only sampled training
+    # rows and the dirty-row block materialize to pandas — a full-table
+    # decode is what made the 1e8-row run OOM
+    from delphi_tpu import table as table_mod
+
+    decoded = []
+    orig = table_mod.EncodedTable.to_pandas
+
+    def spy(self, rows=None, columns=None, integral_as_float=None):
+        decoded.append(self.n_rows if rows is None else len(rows))
+        return orig(self, rows=rows, columns=columns,
+                    integral_as_float=integral_as_float)
+
+    monkeypatch.setattr(table_mod.EncodedTable, "to_pandas", spy)
+    out = _build().run()
+    assert len(out) > 0
+    assert decoded, "expected subset decodes in phases 2-3"
+    assert max(decoded) < 20, f"full-table decode crept back in: {decoded}"
