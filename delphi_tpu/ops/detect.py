@@ -196,7 +196,16 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
 
     # Join keys: left rows keyed by left-attr codes, right rows by right-attr
     # codes, in shared dictionaries (null-safe: NULL code is a key value).
-    if eq:
+    if len(eq) == 1:
+        # Single EQ key (the common FD-style constraint): dictionary codes
+        # are already dense group ids — no hash pass needed at all.
+        p = eq[0]
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+        c1, c2 = _shared_codes(table, p.left.name, p.right.name)
+        g1 = c1.astype(np.int64) + 1  # NULL -> group 0
+        g2 = g1 if c2 is c1 else c2.astype(np.int64) + 1
+        n_groups = int(max(g1.max(initial=0), g2.max(initial=0))) + 1 if n else 0
+    elif eq:
         # Iterative hash-factorization of the composite join key: O(n) per
         # key column instead of np.unique(axis=0)'s O(n log n) lexicographic
         # sort of the full 2D key block — the difference between this and a
