@@ -174,10 +174,14 @@ def _one_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
                     cmp = col.numeric < lit_v if p.sign == "LT" else col.numeric > lit_v
                 cmp = np.where(np.isnan(col.numeric), False, cmp)
             else:
-                vals = col.decode()
-                cmp = np.array(
-                    [(v is not None) and ((v < literal) if p.sign == "LT" else (v > literal))
-                     for v in vals], dtype=bool)
+                # evaluate per DISTINCT value, broadcast through codes
+                # (NULLs never satisfy an order comparison)
+                vocab_cmp = np.array(
+                    [(str(v) < literal) if p.sign == "LT" else (str(v) > literal)
+                     for v in col.vocab], dtype=bool)
+                cmp = np.zeros(table.n_rows, dtype=bool)
+                valid = col.codes != NULL_CODE
+                cmp[valid] = vocab_cmp[col.codes[valid]]
             mask &= cmp
     return mask
 

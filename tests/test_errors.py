@@ -417,3 +417,24 @@ def test_gaussian_outlier_approx_percentiles():
     # to well under 1% of rows
     sym_diff = set(_cells(exact)) ^ set(_cells(approx))
     assert len(sym_diff) < n * 0.01
+
+
+def test_one_tuple_lt_gt_constant_vocab_broadcast():
+    # string LT/GT against a constant evaluates per distinct value and
+    # broadcasts through codes; NULLs never satisfy an order comparison
+    from delphi_tpu.constraints import AttrRef, Constant, Predicate
+    from delphi_tpu.ops.detect import _one_tuple_violations
+    from delphi_tpu.table import encode_table
+
+    df = pd.DataFrame({
+        "tid": range(5),
+        "s": ["apple", "pear", None, "fig", "zoo"],
+        "n": [1.0, 5.0, 3.0, np.nan, 2.0],
+    })
+    t = encode_table(df, "tid")
+    lt = _one_tuple_violations(
+        t, [Predicate("LT", AttrRef("s"), Constant("'m'"))])
+    assert lt.tolist() == [True, False, False, True, False]
+    gt = _one_tuple_violations(
+        t, [Predicate("GT", AttrRef("n"), Constant("2.5"))])
+    assert gt.tolist() == [False, True, True, False, False]
