@@ -32,7 +32,7 @@ from delphi_tpu.models import FeatureEncoder
 from delphi_tpu.regex_repair import RegexStructureRepair
 from delphi_tpu.session import get_session
 from delphi_tpu.table import (
-    EncodedTable, KIND_INTEGRAL, check_input_table)
+    EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, check_input_table)
 from delphi_tpu.train import (
     build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
 from delphi_tpu.utils import (
@@ -912,6 +912,227 @@ class RepairModel:
                 pdf[y] = filled
         return pdf
 
+    def _one_tuple_dc_plan(self, table: EncodedTable,
+                           continuous_columns: List[str],
+                           error_cells_df: pd.DataFrame) -> Optional[Dict[str, Any]]:
+        """Precomputes everything the one-tuple DC minimization needs, ONCE
+        per run (the chunked repair path reuses it across chunks): the
+        parsed all-constant constraints, their violating rows, the flagged
+        cells' current values, and the cells any NON-constraint detector
+        also flagged (those repairs are never reverted — the constraint pass
+        has no business undoing an outlier/regex/domain finding). Returns
+        None when minimization does not apply: no ConstraintErrorDetector,
+        no one-tuple DCs, user-supplied error cells (ground truth is not
+        ours to second-guess), or a detector re-run failing."""
+        from delphi_tpu.constraints import Constant
+        from delphi_tpu.ops.detect import _one_tuple_violations
+
+        if self.error_cells is not None:
+            return None
+        detectors = [d for d in self.error_detectors
+                     if isinstance(d, ConstraintErrorDetector)]
+        if not detectors:
+            return None
+
+        one_tuple = []
+        for d in detectors:
+            try:
+                parsed = d.parsed_constraints(table, str(self.input))
+            except Exception:
+                continue
+            one_tuple += [preds for preds in parsed.predicates
+                          if all(isinstance(p.right, Constant) for p in preds)]
+        if not one_tuple:
+            return None
+
+        protected: set = set()
+        for d in self.error_detectors:
+            if isinstance(d, ConstraintErrorDetector):
+                continue
+            try:
+                cells = d.setUp(self._row_id, str(self.input),
+                                continuous_columns, table.column_names,
+                                encoded_table=table).detect()
+                protected |= set(zip(cells[ROW_IDX].astype(int),
+                                     cells["attribute"]))
+            except Exception as e:
+                _logger.warning(
+                    f"Skipping one-tuple DC minimization ({d} re-run "
+                    f"failed: {e})")
+                return None
+
+        flagged: Dict[int, Dict[str, Any]] = {}
+        for r, a, cur in zip(error_cells_df[ROW_IDX].astype(int),
+                             error_cells_df["attribute"],
+                             error_cells_df["current_value"]):
+            flagged.setdefault(int(r), {})[a] = cur
+
+        plans = []
+        for preds in one_tuple:
+            viol = np.nonzero(_one_tuple_violations(table, preds))[0]
+            if viol.size:
+                plans.append((preds, viol))
+        if not plans:
+            return None
+        return {"plans": plans, "flagged": flagged, "protected": protected,
+                "kinds": {c.name: c.kind for c in table.columns}}
+
+    def _minimize_one_tuple_dc_repairs(
+            self, table: EncodedTable, plan: Optional[Dict[str, Any]],
+            pos: np.ndarray, repaired_rows_df: pd.DataFrame,
+            models: List[Any]) -> pd.DataFrame:
+        """Constraint-aware minimal repair for one-tuple denial constraints.
+
+        A one-tuple DC (all-constant predicates, e.g. Sex=Female &
+        Relationship=Husband) flags EVERY referenced attribute of a violating
+        row, and the models then repair each flagged cell independently —
+        even though changing any ONE of them already satisfies the
+        constraint. When several flagged cells of a row would individually
+        satisfy the DC, keep only the repair the models are most confident
+        in and revert the others to their (non-NULL) current values: the
+        minimal-change repair HoloClean-style systems aim for. Cells the
+        constraint still needs, cells with NULL currents, and cells another
+        detector flagged keep their repairs; rows where model confidence is
+        unavailable for every option are left untouched."""
+        if plan is None or not len(repaired_rows_df):
+            return repaired_rows_df
+
+        flagged = plan["flagged"]
+        protected = plan["protected"]
+        kinds = plan["kinds"]
+        pos_index = {int(p): i for i, p in enumerate(pos)}
+
+        def spell(attr: str, value: Any) -> Optional[str]:
+            """The vocab spelling of a value — what _one_tuple_violations
+            compares against the literal (str(int)/str(float) for numeric
+            kinds, the raw string otherwise)."""
+            if _is_null(value):
+                return None
+            kind = kinds.get(attr)
+            try:
+                if kind == KIND_INTEGRAL:
+                    return str(int(float(value)))
+                if kind == KIND_FRACTIONAL:
+                    return str(float(value))
+            except (TypeError, ValueError):
+                pass
+            return str(value)
+
+        def pred_holds(p: Any, attr: str, value: Any) -> bool:
+            s = spell(attr, value)
+            lit = p.right.literal
+            if s is None:
+                # NULL <=> const is false; NOT(...) true; orders false
+                return p.sign == "IQ"
+            if p.sign == "EQ":
+                return s == lit
+            if p.sign == "IQ":
+                return s != lit
+            if kinds.get(attr) in (KIND_INTEGRAL, KIND_FRACTIONAL):
+                try:
+                    lv, rv = float(s), float(lit)
+                except ValueError:
+                    return False
+                return lv < rv if p.sign == "LT" else lv > rv
+            return s < lit if p.sign == "LT" else s > lit
+
+        def batch_confidence(attr: str, row_is: List[int]) -> Optional[np.ndarray]:
+            """P(model predicts the repaired value) for many rows in one
+            predict_proba launch; None disables minimization for these rows
+            (a failed confidence must not degrade into an arbitrary pick)."""
+            for y, (model, features, transformers) in models:
+                if y != attr:
+                    continue
+                try:
+                    X: Any = repaired_rows_df[features].iloc[row_is]
+                    if transformers:
+                        for t in transformers:
+                            X = t.transform(X)
+                    probs = np.asarray(model.predict_proba(X))
+                    classes = [str(c) for c in model.classes_.tolist()]
+                    vals = [str(repaired_rows_df.at[repaired_rows_df.index[i],
+                                                    attr]) for i in row_is]
+                    idx = [classes.index(v) if v in classes else -1
+                           for v in vals]
+                    return np.asarray(
+                        [probs[j, k] if k >= 0 else np.nan
+                         for j, k in enumerate(idx)], dtype=np.float64)
+                except Exception:
+                    return None
+            return None
+
+        out = repaired_rows_df
+        for preds, viol_rows in plan["plans"]:
+            dc_attrs = [a for p in preds for a in p.references]
+            # only this chunk's rows (the plan's rows are global)
+            in_chunk = viol_rows[np.isin(viol_rows, pos)] \
+                if len(viol_rows) > len(pos_index) // 4 else \
+                [r for r in viol_rows if int(r) in pos_index]
+            candidates = []  # (i, row_flagged, options)
+            need_conf: Dict[str, List[int]] = {}
+            for r in in_chunk:
+                i = pos_index.get(int(r))
+                if i is None:
+                    continue
+                row_flagged = flagged.get(int(r), {})
+                fixable = [a for a in dc_attrs
+                           if a in row_flagged and a in out.columns
+                           and (int(r), a) not in protected
+                           and not _is_null(row_flagged[a])]
+                if len(fixable) < 2:
+                    continue
+                fixable_set = set(fixable)
+
+                def satisfied_by(only: str) -> bool:
+                    # `only` takes its repair, other revertible flagged cells
+                    # take their current values; everything else (unflagged
+                    # attrs, must-keep repairs) reads the repaired frame
+                    def val(a: str) -> Any:
+                        if a != only and a in fixable_set:
+                            return row_flagged[a]
+                        return out.at[out.index[i], a]
+                    return not all(pred_holds(p, p.references[0],
+                                              val(p.references[0]))
+                                   for p in preds)
+
+                options = [a for a in fixable if satisfied_by(a)]
+                if len(options) < 1:
+                    continue
+                candidates.append((i, int(r), row_flagged, fixable, options))
+                for a in options:
+                    need_conf.setdefault(a, []).append(i)
+
+            conf: Dict[Tuple[str, int], float] = {}
+            usable = True
+            for a, row_is in need_conf.items():
+                scores = batch_confidence(a, row_is)
+                if scores is None:
+                    usable = False
+                    break
+                for i, s in zip(row_is, scores):
+                    conf[(a, i)] = float(s)
+            if not usable:
+                continue
+
+            for i, r, row_flagged, fixable, options in candidates:
+                scored = [(conf.get((a, i), np.nan), a) for a in options]
+                if any(np.isnan(s) for s, _ in scored):
+                    continue  # confidence unavailable -> keep all repairs
+                best = max(scored)[1]
+                reverted = []
+                for a in fixable:
+                    if a != best:
+                        out.at[out.index[i], a] = row_flagged[a]
+                        reverted.append(a)
+                if reverted:
+                    _logger.info(
+                        "[Repairing Phase] one-tuple DC on row {}: keeping "
+                        "the '{}' repair and reverting {} (constraint "
+                        "satisfied by a single change)".format(
+                            table.row_id_values[r], best,
+                            to_list_str(reverted, quote=True)))
+        return out
+
     def _flatten(self, df: pd.DataFrame) -> pd.DataFrame:
         """(row_id, attribute, value) long view (RepairMiscApi.scala:41-49);
         values keep their python objects (PMF dicts pass through). Column-
@@ -1316,6 +1537,8 @@ class RepairModel:
         # 3. Repair Phase
         #######################################################################
         need_pmf = compute_repair_candidate_prob or maximal_likelihood_repair
+        dc_plan = self._one_tuple_dc_plan(
+            table, continuous_columns, error_cells_df) if not need_pmf else None
         chunk_rows = int(os.environ.get("DELPHI_REPAIR_CHUNK_ROWS", "2000000"))
         if not (need_pmf or repair_data or self.repair_validation_enabled
                 or self.repair_by_rules) \
@@ -1330,6 +1553,8 @@ class RepairModel:
                 repaired_chunk = self._repair(
                     models, continuous_columns, dirty_chunk, error_cells_df,
                     compute_repair_candidate_prob, maximal_likelihood_repair)
+                repaired_chunk = self._minimize_one_tuple_dc_repairs(
+                    table, dc_plan, pos, repaired_chunk, models)
                 parts.append(self._extract_repair_candidates(
                     repaired_chunk, error_cells_df, target_columns))
             return pd.concat(parts, ignore_index=True)
@@ -1339,6 +1564,8 @@ class RepairModel:
         repaired_rows_df = self._repair(
             models, continuous_columns, dirty_rows_df, error_cells_df,
             compute_repair_candidate_prob, maximal_likelihood_repair)
+        repaired_rows_df = self._minimize_one_tuple_dc_repairs(
+            table, dc_plan, error_row_pos, repaired_rows_df, models)
 
         if compute_repair_candidate_prob and not maximal_likelihood_repair:
             assert not self._repair_by_nearest_values_enabled, \

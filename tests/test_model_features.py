@@ -456,3 +456,23 @@ def test_phases_2_3_never_decode_the_full_table(adult, session, monkeypatch):
     assert len(out) > 0
     assert decoded, "expected subset decodes in phases 2-3"
     assert max(decoded) < n_rows, f"full-table decode crept back in: {decoded}"
+
+
+def test_one_tuple_dc_minimal_repair(adult, session):
+    # (4, Sex)=Female & (4, Relationship)=Husband violates the one-tuple DC;
+    # either single change satisfies it, so only the higher-confidence
+    # repair (Sex -> Male, implied by Husband) survives and Relationship
+    # keeps its current value — the minimal-change repair
+    from conftest import BIN_TESTDATA
+    from delphi_tpu.errors import ConstraintErrorDetector
+
+    out = delphi.repair.setInput("adult").setRowId("tid") \
+        .setErrorDetectors([
+            NullErrorDetector(),
+            ConstraintErrorDetector(str(BIN_TESTDATA / "adult_constraints.txt")),
+        ]).run()
+    cells = {(t, a): r for t, a, r in
+             zip(out["tid"], out["attribute"], out["repaired"])}
+    assert cells[(4, "Sex")] == "Male"
+    assert (4, "Relationship") not in cells
+    assert cells[(11, "Sex")] == "Male"
