@@ -274,6 +274,9 @@ def _child_main(args: argparse.Namespace) -> None:
             format="%(asctime)s %(levelname)s %(message)s", stream=sys.stderr)
     if os.environ.get("DELPHI_BENCH_BACKEND") == "cpu":
         _force_cpu_backend()
+    # delphi_tpu's import-time env setup (XLA:CPU ISA cap, compile-cache
+    # scoping) must land BEFORE the first backend touch to take effect
+    import delphi_tpu  # noqa: F401
     # Initialize the backend up front and announce it, so the parent can
     # bound backend init separately from the (long) workload budget.
     import jax

@@ -170,3 +170,25 @@ def test_sharded_domain_scores_bit_identical_to_host(mesh, monkeypatch):
 
     np.testing.assert_array_equal(mesh_contrib, host_contrib)
     np.testing.assert_array_equal(mesh_prob, host_prob)  # bit-exact
+
+
+def test_tree_scatter_and_matmul_histograms_agree():
+    # CPU CI must keep covering the matmul histogram branch production TPU
+    # uses: both strategies are exact sums, so trees must match
+    import jax.numpy as jnp
+    from delphi_tpu.models.gbdt import _build_tree
+
+    rng = np.random.RandomState(11)
+    n, d, B, depth = 512, 6, 16, 4
+    bins = jnp.asarray(rng.randint(0, B, (n, d)), jnp.int32)
+    grad = jnp.asarray(rng.randn(n), jnp.float32)
+    hess = jnp.asarray(np.abs(rng.randn(n)) + 0.1, jnp.float32)
+    w = jnp.asarray((rng.rand(n) > 0.05).astype(np.float32))
+    args = (bins, grad, hess, w, depth, B + 1, 1 << depth,
+            1.0, 0.0, 1.0, 0.0)
+    f1, t1, l1, n1 = _build_tree(*args, use_scatter=True)
+    f2, t2, l2, n2 = _build_tree(*args, use_scatter=False)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
