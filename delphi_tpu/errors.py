@@ -531,14 +531,14 @@ class ErrorModel:
                                   freq: FreqStats,
                                   domain_stats: Dict[str, int]) -> pd.DataFrame:
         _logger.info("[Error Detection Phase] Analyzing cell domains to fix error cells...")
-        cells = [
-            (int(r), a, c) for r, a, c in zip(
-                noisy_cells_df[ROW_IDX], noisy_cells_df["attribute"],
-                noisy_cells_df["current_value"])
-        ]
+        # columns pulled to numpy ONCE: per-element iteration of (possibly
+        # Arrow-backed) Series costs seconds per million cells
+        rows_np = noisy_cells_df[ROW_IDX].to_numpy().astype(np.int64)
+        attrs_np = noisy_cells_df["attribute"].to_numpy(dtype=object)
+        curs_np = noisy_cells_df["current_value"].to_numpy(dtype=object)
         domains = compute_domain_in_error_cells(
-            disc, cells, continuous_columns, target_columns, freq, pairwise,
-            domain_stats,
+            disc, (rows_np, attrs_np, curs_np), continuous_columns,
+            target_columns, freq, pairwise, domain_stats,
             self._get_option_value(*self._opt_max_attrs_to_compute_domains),
             self._get_option_value(*self._opt_domain_threshold_alpha),
             self._get_option_value(*self._opt_domain_threshold_beta))
@@ -550,10 +550,17 @@ class ErrorModel:
             if d.domain and d.current_value is not None and d.domain[0][0] == d.current_value:
                 fixed.add((d.row_index, d.attribute))
 
-        keep = [
-            (int(r), a) not in fixed
-            for r, a in zip(noisy_cells_df[ROW_IDX], noisy_cells_df["attribute"])
-        ]
+        if fixed:
+            # vectorized pair membership over a fused (row, attribute) key
+            attr_codes, attr_uniques = pd.factorize(attrs_np)
+            attr_index = {a: i for i, a in enumerate(attr_uniques)}
+            key = rows_np * len(attr_uniques) + attr_codes
+            fixed_keys = np.fromiter(
+                (r * len(attr_uniques) + attr_index[a] for r, a in fixed
+                 if a in attr_index), dtype=np.int64)
+            keep = ~np.isin(key, fixed_keys)
+        else:
+            keep = np.ones(len(noisy_cells_df), dtype=bool)
         error_cells_df = noisy_cells_df[keep].reset_index(drop=True)
         assert len(noisy_cells_df) == len(error_cells_df) + len(fixed)
         _logger.info(

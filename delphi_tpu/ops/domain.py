@@ -45,7 +45,10 @@ def compute_domain_in_error_cells(
         max_attrs_to_compute_domains: int,
         alpha: float,
         beta: float) -> List[CellDomain]:
-    """``cells``: (row_index, attribute, current_value_string) triples.
+    """``cells``: (row_index, attribute, current_value_string) triples, or —
+    the at-scale form — a 3-tuple of aligned arrays (rows int64[n],
+    attributes object[n], current values object[n]) which avoids building
+    millions of Python tuples.
 
     Returns one :class:`CellDomain` per input cell whose attribute is in
     ``target_attrs`` (same filtering as RepairApi.scala:530-531).
@@ -58,15 +61,26 @@ def compute_domain_in_error_cells(
     continuous = set(continuous_attrs)
     table = disc.table
 
-    out: List[CellDomain] = []
-    by_attr: Dict[str, List[Tuple[int, Optional[str]]]] = {}
-    for row, attr, cur in cells:
-        if attr in target_attrs:
-            by_attr.setdefault(attr, []).append((row, cur))
+    if isinstance(cells, tuple) and len(cells) == 3 \
+            and isinstance(cells[0], np.ndarray):
+        rows_all, attrs_all, curs_all = cells
+    else:
+        rows_all = np.fromiter((int(r) for r, _, _ in cells), dtype=np.int64,
+                               count=len(cells))
+        attrs_all = np.array([a for _, a, _ in cells], dtype=object)
+        curs_all = np.array([c for _, _, c in cells], dtype=object)
 
-    for attr, attr_cells in by_attr.items():
-        rows = np.asarray([r for r, _ in attr_cells], dtype=np.int64)
-        currents = [c for _, c in attr_cells]
+    out: List[CellDomain] = []
+    import pandas as pd
+    attr_codes, attr_uniques = pd.factorize(attrs_all) if len(attrs_all) \
+        else (np.zeros(0, np.int64), np.zeros(0, object))
+
+    for ai, attr in enumerate(attr_uniques):
+        if attr not in target_attrs:
+            continue
+        sel = attr_codes == ai
+        rows = rows_all[sel]
+        currents = curs_all[sel]
 
         corr_attrs = [c for c, _ in pairwise_stats.get(attr, [])][:max_attrs_to_compute_domains]
         corr_attrs = [c for c in corr_attrs if freq.has_pair(c, attr)]
