@@ -623,6 +623,17 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                     score = -float(((pred - yv64[fold]) ** 2).mean())
                 per_config.setdefault(ci, []).append(score)
 
+        # Early exit on a PERFECT classifier score: a config already at
+        # macro-F1 1.0 on every fold cannot be beaten, so the remaining
+        # shape groups' launches are pure cost (on easy targets like
+        # hospital State this halves the search). Only the group just
+        # scored can newly qualify.
+        if is_discrete and any(
+                len(per_config.get(ci, ())) == len(fold_prep)
+                and min(per_config[ci]) >= 1.0 - 1e-12
+                for ci in cfg_indices):
+            break
+
     if not per_config:
         return 0, -np.inf
     if timed_out:
