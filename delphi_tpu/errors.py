@@ -386,6 +386,11 @@ class ErrorModel:
         # Populated during detect() for downstream phases
         self.discretized: Optional[DiscretizedTable] = None
         self.freq_stats: Optional[FreqStats] = None
+        # Cells flagged by NON-constraint detectors during phase 1, as
+        # (row_idx, attribute) pairs — captured so the one-tuple DC repair
+        # minimization can protect them without re-running detection (the
+        # dominant phase at scale). None until detectors actually run.
+        self.non_constraint_cells: Optional[set] = None
 
     def _get_option_value(self, *args) -> Any:  # type: ignore
         return get_option_value(self.opts, *args)
@@ -411,10 +416,15 @@ class ErrorModel:
         target_attrs = self._target_attrs([self.row_id] + table.column_names)
 
         frames = []
+        self.non_constraint_cells = set()
         for d in detectors:
             d.setUp(self.row_id, input_name, continuous_columns, target_attrs,
                     encoded_table=table)
-            frames.append(d.detect())
+            cells = d.detect()
+            frames.append(cells)
+            if not isinstance(d, ConstraintErrorDetector) and len(cells):
+                self.non_constraint_cells |= set(
+                    zip(cells[ROW_IDX].astype(int), cells["attribute"]))
         merged = pd.concat(frames, ignore_index=True) if frames \
             else pd.DataFrame(columns=[self.row_id, "attribute", ROW_IDX])
         return merged.drop_duplicates(subset=[self.row_id, "attribute"],

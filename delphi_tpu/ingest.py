@@ -193,9 +193,15 @@ def read_csv_encoded_sharded(path: str, row_id: str,
     rank, world = jax.process_index(), jax.process_count()
     read_kwargs.setdefault("dtype", str)
     reader = pd.read_csv(path, chunksize=chunksize, **read_kwargs)
-    own = [chunk for i, chunk in enumerate(reader) if i % world == rank]
-    if own:
-        local = encode_table_chunked(iter(own), row_id)
+    # stream the rank's chunks straight into the incremental encoder (one
+    # chunk of pandas objects in flight at a time — materializing the whole
+    # 1/P shard as object DataFrames first would defeat the streaming
+    # design); a one-chunk peek detects the zero-chunk case
+    own = (chunk for i, chunk in enumerate(reader) if i % world == rank)
+    first = next(own, None)
+    if first is not None:
+        import itertools
+        local = encode_table_chunked(itertools.chain([first], own), row_id)
     else:
         # fewer chunks than processes: this rank holds zero rows but must
         # still join the vocabulary all-gather (a missing rank would hang

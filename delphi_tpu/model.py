@@ -18,6 +18,7 @@ import hashlib
 import heapq
 import os
 import pickle
+import zlib
 from collections import namedtuple
 from typing import Any, Dict, List, Optional, Set, Tuple, Union
 
@@ -460,7 +461,11 @@ class RepairModel:
             error_detectors=self.error_detectors,
             error_cells=self._error_cells_frame,
             opts=self.opts)
-        return error_model.detect(table, input_name, continuous_columns)
+        result = error_model.detect(table, input_name, continuous_columns)
+        # keep phase 1's per-detector capture so the one-tuple DC repair
+        # minimization never re-runs detection (the dominant cost at scale)
+        self._phase1_non_constraint_cells = error_model.non_constraint_cells
+        return result
 
     # -- phase 2 helpers: rule-based repairs ----------------------------------
 
@@ -623,6 +628,23 @@ class RepairModel:
             features = [f for _, f in top_k]
         return features
 
+    @staticmethod
+    def _encode_features(transformers: List[Any], X: Any,
+                         fit: bool = False, compact: bool = True) -> Any:
+        """Runs the feature transformers, routing FeatureEncoder through the
+        factored one-hot design (the linear heads' gather path) unless the
+        caller needs a dense, row-indexable matrix (``compact=False``, e.g.
+        rebalancing). The single dispatch point keeps the train- and
+        predict-side encodings in lockstep."""
+        for t in transformers:
+            use_compact = compact and isinstance(t, FeatureEncoder)
+            if fit:
+                X = t.fit_transform_compact(X) if use_compact \
+                    else t.fit_transform(X)
+            else:
+                X = t.transform_compact(X) if use_compact else t.transform(X)
+        return X
+
     def _create_transformers(self, domain_stats: Dict[str, Any],
                              features: List[str],
                              continuous_columns: List[str],
@@ -703,9 +725,13 @@ class RepairModel:
             is_discrete = y not in continuous_columns
             model_type = "classfier" if is_discrete else "regressor"
 
-            X: Any = train_pdf[feature_map[y]]
-            for transformer in transformer_map[y]:
-                X = transformer.fit_transform(X)
+            # linear-head targets train from the factored one-hot design —
+            # gathers instead of dense-width matmuls (rebalancing needs row
+            # indexing, so it keeps dense)
+            X: Any = self._encode_features(
+                transformer_map[y], train_pdf[feature_map[y]], fit=True,
+                compact=not (is_discrete
+                             and self.training_data_rebalancing_enabled))
 
             if is_discrete and self.training_data_rebalancing_enabled:
                 X, y_ = rebalance_training_data(X, train_pdf[y], y)
@@ -879,8 +905,7 @@ class RepairModel:
             # repair — the clean cells of the dirty block keep their values.
             X: Any = pdf[features].iloc[miss_idx]
             if transformers:
-                for transformer in transformers:
-                    X = transformer.transform(X)
+                X = self._encode_features(transformers, X)
 
             if need_pmf and y not in continuous_columns:
                 predicted = model.predict_proba(X)
@@ -920,10 +945,11 @@ class RepairModel:
         parsed all-constant constraints, their violating rows, the flagged
         cells' current values, and the cells any NON-constraint detector
         also flagged (those repairs are never reverted — the constraint pass
-        has no business undoing an outlier/regex/domain finding). Returns
-        None when minimization does not apply: no ConstraintErrorDetector,
-        no one-tuple DCs, user-supplied error cells (ground truth is not
-        ours to second-guess), or a detector re-run failing."""
+        has no business undoing an outlier/regex/domain finding; the set is
+        captured during phase 1, never re-detected). Returns None when
+        minimization does not apply: no ConstraintErrorDetector, no
+        one-tuple DCs, or user-supplied error cells (ground truth is not
+        ours to second-guess)."""
         from delphi_tpu.constraints import Constant
         from delphi_tpu.ops.detect import _one_tuple_violations
 
@@ -945,21 +971,14 @@ class RepairModel:
         if not one_tuple:
             return None
 
-        protected: set = set()
-        for d in self.error_detectors:
-            if isinstance(d, ConstraintErrorDetector):
-                continue
-            try:
-                cells = d.setUp(self._row_id, str(self.input),
-                                continuous_columns, table.column_names,
-                                encoded_table=table).detect()
-                protected |= set(zip(cells[ROW_IDX].astype(int),
-                                     cells["attribute"]))
-            except Exception as e:
-                _logger.warning(
-                    f"Skipping one-tuple DC minimization ({d} re-run "
-                    f"failed: {e})")
-                return None
+        protected = getattr(self, "_phase1_non_constraint_cells", None)
+        if protected is None:
+            # detectors never ran (defensive: this path requires
+            # error_cells None, so phase 1 must have populated the capture)
+            _logger.warning(
+                "Skipping one-tuple DC minimization (phase-1 detector "
+                "capture unavailable)")
+            return None
 
         flagged: Dict[int, Dict[str, Any]] = {}
         for r, a, cur in zip(error_cells_df[ROW_IDX].astype(int),
@@ -1046,8 +1065,7 @@ class RepairModel:
                 try:
                     X: Any = repaired_rows_df[features].iloc[row_is]
                     if transformers:
-                        for t in transformers:
-                            X = t.transform(X)
+                        X = self._encode_features(transformers, X)
                     probs = np.asarray(model.predict_proba(X))
                     classes = [str(c) for c in model.classes_.tolist()]
                     vals = [str(repaired_rows_df.at[repaired_rows_df.index[i],
@@ -1062,7 +1080,13 @@ class RepairModel:
             return None
 
         out = repaired_rows_df
-        for preds, viol_rows in plan["plans"]:
+        # (frame position, attr) -> the ORIGINAL model repair, recorded the
+        # first time any plan reverts that cell (later plans reverting the
+        # same cell see the already-reverted value, which is not a repair) —
+        # the post-pass below undoes reverts that overlapping-attribute
+        # plans invalidated
+        revert_log: Dict[Tuple[int, str], Any] = {}
+        for plan_idx, (preds, viol_rows) in enumerate(plan["plans"]):
             dc_attrs = [a for p in preds for a in p.references]
             # only this chunk's rows (the plan's rows are global)
             in_chunk = viol_rows[np.isin(viol_rows, pos)] \
@@ -1122,6 +1146,8 @@ class RepairModel:
                 reverted = []
                 for a in fixable:
                     if a != best:
+                        revert_log.setdefault(
+                            (i, a), out.at[out.index[i], a])
                         out.at[out.index[i], a] = row_flagged[a]
                         reverted.append(a)
                 if reverted:
@@ -1131,6 +1157,40 @@ class RepairModel:
                         "satisfied by a single change)".format(
                             table.row_id_values[r], best,
                             to_list_str(reverted, quote=True)))
+
+        # Plans apply sequentially against the mutated frame, so with two
+        # DCs sharing an attribute a later plan's revert can re-violate an
+        # earlier constraint (its kept repair depended on a cell the later
+        # plan put back). Fixpoint pass: re-evaluate every processed
+        # constraint on the FINAL row state; a still-violated constraint
+        # gets ALL reverted cells among its referenced attributes restored
+        # to their original model repairs — whichever plan reverted them.
+        # Each (row, cell) restores at most once and restores only move the
+        # row toward the un-minimized all-repairs state (which satisfied
+        # every constraint), so the loop is monotone and terminates.
+        if revert_log:
+            touched_rows = {i for i, _ in revert_log}
+            for _ in range(len(plan["plans"]) + 1):
+                changed = False
+                for preds, _ in plan["plans"]:
+                    attrs = {a for p in preds for a in p.references}
+                    for i in touched_rows:
+                        restorable = [a for a in attrs
+                                      if (i, a) in revert_log]
+                        if not restorable:
+                            continue
+                        violated = all(
+                            pred_holds(p, p.references[0],
+                                       out.at[out.index[i],
+                                              p.references[0]])
+                            for p in preds)
+                        if violated:
+                            for a in restorable:
+                                out.at[out.index[i], a] = \
+                                    revert_log.pop((i, a))
+                            changed = True
+                if not changed:
+                    break
         return out
 
     def _flatten(self, df: pd.DataFrame) -> pd.DataFrame:
@@ -1377,23 +1437,31 @@ class RepairModel:
         checkpoint is only reused when all of these match, so a different
         table (or the same table with edited rows/options) retrains."""
         # Content hash over the encoded table: full vocabularies (new/renamed
-        # values always flip it) plus a bounded stride sample of each code
-        # column, so validation stays ~O(1) at the 1e8-row north star. A
-        # single-cell edit off the sample lattice that reuses existing vocab
-        # entries can slip past the sampled hash; DELPHI_CHECKPOINT_FULL_HASH=1
-        # opts into hashing every code row.
-        full = os.environ.get("DELPHI_CHECKPOINT_FULL_HASH") == "1"
-        stride = 1 if full else max(1, masked.n_rows // 65536)
+        # values always flip it) plus, by default, a FULL pass over every
+        # code column via crc32 (~GB/s, memory-bandwidth bound — negligible
+        # next to the runs worth checkpointing), so any single-cell edit
+        # flips the fingerprint. DELPHI_CHECKPOINT_SAMPLED_HASH=1 opts into
+        # the bounded stride sample instead (~O(1) rows hashed), accepting
+        # that an edit off the sample lattice reusing existing vocab entries
+        # can slip past.
+        sampled = os.environ.get("DELPHI_CHECKPOINT_SAMPLED_HASH") == "1"
+        stride = max(1, masked.n_rows // 65536) if sampled else 1
         h = hashlib.sha1()
-        h.update(b"full" if full else b"sampled")
+        h.update(b"sampled" if sampled else b"full")
         h.update(np.int64(masked.n_rows).tobytes())
         for c in masked.columns:
             h.update(c.name.encode("utf-8", "replace"))
             h.update("\x00".join(str(v) for v in c.vocab).encode(
                 "utf-8", "replace"))
-            h.update(np.ascontiguousarray(c.codes[::stride]).tobytes())
-            if masked.n_rows:
-                h.update(np.ascontiguousarray(c.codes[-1:]).tobytes())
+            if sampled:
+                h.update(np.ascontiguousarray(c.codes[::stride]).tobytes())
+                if masked.n_rows:
+                    h.update(np.ascontiguousarray(c.codes[-1:]).tobytes())
+            else:
+                # crc32 accepts any buffer — no .tobytes() copy (a second
+                # ~400MB allocation per column at the 1e8-row north star)
+                crc = zlib.crc32(np.ascontiguousarray(c.codes))
+                h.update(np.uint32(crc).tobytes())
         content = h.hexdigest()
         return {
             "version": 4,

@@ -76,6 +76,44 @@ def balanced_class_weights(counts: np.ndarray, n: int,
     return np.sqrt(raw) if damped else raw
 
 
+class OneHotDesign:
+    """Compact factorization of :class:`FeatureEncoder`'s dense design
+    matrix. The dense matrix is block-sparse — one 1.0 per discrete-feature
+    block — so ``X @ W`` is really an embedding gather: storing the per-block
+    LOCAL hot index (``cat_idx``) plus the dense continuous columns lets the
+    logistic head train with O(n * F * k) gathers instead of O(n * D * k)
+    matmul FLOPs (D is the summed vocab width, often hundreds of times F).
+    ``layout`` records each feature's dense column span so the dense matrix
+    (or dense-equivalent weights) can always be reconstructed."""
+
+    def __init__(self, cat_idx: np.ndarray, cont: np.ndarray,
+                 cat_sizes: List[int], layout: List[tuple],
+                 width: int) -> None:
+        self.cat_idx = cat_idx      # int32 [n, Fc], local index per block
+        self.cont = cont            # float32 [n, Fd]
+        self.cat_sizes = cat_sizes  # [Fc] block widths (vocab + unknown slot)
+        self.layout = layout        # [("cat"|"cont", dense_start, slot)]
+        self.width = width          # dense column count
+
+    @property
+    def shape(self):
+        return (self.cat_idx.shape[0], self.width)
+
+    def __len__(self) -> int:
+        return self.cat_idx.shape[0]
+
+    def dense(self) -> np.ndarray:
+        n = len(self)
+        out = np.zeros((n, self.width), dtype=np.float32)
+        rows = np.arange(n)
+        for kind, start, slot in self.layout:
+            if kind == "cat":
+                out[rows, start + self.cat_idx[:, slot]] = 1.0
+            else:
+                out[:, start] = self.cont[:, slot]
+        return out
+
+
 class FeatureEncoder:
     """fit/transform over pandas feature frames -> float32 [n, D]."""
 
@@ -130,6 +168,38 @@ class FeatureEncoder:
 
     def fit_transform(self, X: pd.DataFrame) -> np.ndarray:
         return self.fit(X).transform(X)
+
+    def transform_compact(self, X: pd.DataFrame) -> OneHotDesign:
+        """Same encoding as :meth:`transform` in the factored
+        :class:`OneHotDesign` form (``design.dense()`` reproduces
+        ``transform(X)`` exactly)."""
+        assert self._fitted, "fit() must be called before transform_compact()"
+        n = len(X)
+        cat_cols, cat_sizes, cont_cols, layout = [], [], [], []
+        d = 0
+        for f in self.features:
+            if f in self.continuous:
+                v = pd.to_numeric(X[f], errors="coerce").to_numpy(dtype=np.float64)
+                v = (v - self._mean[f]) / self._std[f]
+                layout.append(("cont", d, len(cont_cols)))
+                cont_cols.append(np.where(np.isnan(v), 0.0, v).astype(np.float32))
+                d += 1
+            else:
+                vocab = self._vocab[f]
+                width = len(vocab) + 1
+                layout.append(("cat", d, len(cat_cols)))
+                cat_cols.append(_vocab_codes(X[f], vocab, len(vocab))
+                                .astype(np.int32))
+                cat_sizes.append(width)
+                d += width
+        cat_idx = np.stack(cat_cols, axis=1) if cat_cols \
+            else np.zeros((n, 0), np.int32)
+        cont = np.stack(cont_cols, axis=1) if cont_cols \
+            else np.zeros((n, 0), np.float32)
+        return OneHotDesign(cat_idx, cont, cat_sizes, layout, self.n_dims)
+
+    def fit_transform_compact(self, X: pd.DataFrame) -> OneHotDesign:
+        return self.fit(X).transform_compact(X)
 
 
 class OrdinalEncoder:

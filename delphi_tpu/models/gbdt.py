@@ -206,12 +206,33 @@ def _predict_tree(bins, feat, thr, leaf, depth):
 # Boosting
 # ---------------------------------------------------------------------------
 
+# Boosting runs in fixed-size chunks of this many rounds: ONE compiled chunk
+# program serves every total round count (25, 50, ... 200), which is what
+# makes per-target early stopping free of recompilation — the reference gets
+# the same effect from LightGBM's dynamic `early_stopping_rounds`
+# (train.py:193-200) because its trees are built by interpreted C++.
+_CHUNK_ROUNDS = 25
+
+
+def _round_chunks(n_rounds: int) -> List[int]:
+    q, r = divmod(max(int(n_rounds), 1), _CHUNK_ROUNDS)
+    return [_CHUNK_ROUNDS] * q + ([r] if r else [])
+
+
 @partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
-                                   "objective", "k", "axis_name"))
-def _boost(bins, y, weight, n_rounds, depth, n_bins, n_nodes, objective, k,
-           lr, reg_lambda, min_split_gain, min_child_weight, base_score,
-           min_child_samples=20.0, axis_name=None):
-    """Runs the full boosting loop as one lax.scan; returns stacked trees."""
+                                   "objective", "k", "axis_name",
+                                   "collect_trees"))
+def _boost(bins, y, weight, F0, n_rounds, depth, n_bins, n_nodes, objective,
+           k, lr, reg_lambda, min_split_gain, min_child_weight,
+           min_child_samples=20.0, axis_name=None, collect_trees=True):
+    """Runs ``n_rounds`` boosting rounds as one lax.scan, RESUMING from the
+    margin state ``F0`` (rows-first: [n], or [n, k] for multiclass — the
+    layout row sharding understands). Returns (F, stacked trees), F
+    rows-first again, so fits advance in fixed-size chunks with the carry
+    living on device between launches. ``collect_trees=False`` drops the
+    stacked tree outputs (the CV scorer only needs the margins — the carry
+    F IS the model's prediction on every row, held-out weight-0 rows
+    included, so CV never runs a separate predict pass)."""
     n = bins.shape[0]
 
     def grad_hess(F):
@@ -245,18 +266,39 @@ def _boost(bins, y, weight, n_rounds, depth, n_bins, n_nodes, objective, k,
         leaf = leaf * lr
         delta = jnp.take_along_axis(leaf, node, axis=1)  # [k_trees, n]
         F = F + (delta[0] if objective != "multiclass" else delta)
-        return F, (feat, thr, leaf)
+        return F, ((feat, thr, leaf) if collect_trees else None)
 
+    F_init = F0.T if objective == "multiclass" else F0
+    F, trees = jax.lax.scan(one_round, F_init, None, length=n_rounds)
+    F_out = F.T if objective == "multiclass" else F
+    return (F_out, trees) if collect_trees else F_out
+
+
+def _init_margin(base: np.ndarray, n: int, objective: str, k: int) -> np.ndarray:
+    """Rows-first initial margin state from per-class base scores."""
+    base = np.asarray(base, np.float32)
     if objective == "multiclass":
-        F0 = jnp.broadcast_to(base_score[:, None], (k, n))
-    else:
-        F0 = jnp.full((n,), base_score[0])
-    if axis_name is not None:
-        # under shard_map the carry accumulates row-local (varying) deltas;
-        # mark the replicated init as varying so scan's carry types match
-        F0 = jax.lax.pcast(F0, (axis_name,), to="varying")
-    _, trees = jax.lax.scan(one_round, F0, None, length=n_rounds)
-    return trees
+        return np.broadcast_to(base[None, :], (n, k)).copy()
+    return np.full((n,), base[0], np.float32)
+
+
+def train_row_target(n: int, mesh: Any = None) -> int:
+    """Training-row pad target: power of two below 4096 (the recompilation
+    bound matters most for tiny per-attribute fits), then the next multiple
+    of 2048. The training path is capped by `model.max_training_row_num`
+    (10k default), so the variant count stays small while the default cap
+    pads 10000 -> 10240 instead of 16384 — a free 1.6x on every histogram
+    and gather in phases 2's hot loops. Prediction keeps power-of-two
+    padding: dirty-row counts vary per attribute, so fine-grained targets
+    there would multiply compiled variants."""
+    if n <= 4096:
+        from delphi_tpu.parallel.mesh import padded_row_target
+        return padded_row_target(n, mesh)
+    target = -(-n // 2048) * 2048
+    if mesh is not None:
+        dp = int(mesh.shape["dp"])
+        target = -(-target // dp) * dp
+    return target
 
 
 @partial(jax.jit, static_argnames=("n_rounds", "depth", "objective", "k",
@@ -294,38 +336,23 @@ def _mesh_boost_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k,
                    min_child_samples):
     """Cached, jitted shard_map program for one (mesh, hyperparameter)
     combination — per-attribute fits with the same shapes reuse the same
-    compiled executable instead of retracing."""
+    compiled executable instead of retracing. Takes and returns the
+    rows-first margin carry (sharded over dp) so chunked fits resume
+    across launches without gathering F."""
     from jax.sharding import PartitionSpec as P
 
     from delphi_tpu.parallel.mesh import shard_map
 
-    def fn(bins_l, y_l, w_l, base):
-        return _boost(bins_l, y_l, w_l, n_rounds, depth, n_bins, n_nodes,
-                      objective, k, lr, reg_lambda, min_split_gain,
-                      min_child_weight, base, min_child_samples,
-                      axis_name="dp")
+    def fn(bins_l, y_l, w_l, F0_l):
+        return _boost(bins_l, y_l, w_l, F0_l, n_rounds, depth, n_bins,
+                      n_nodes, objective, k, lr, reg_lambda, min_split_gain,
+                      min_child_weight, min_child_samples, axis_name="dp")
 
+    F_spec = P("dp", None) if objective == "multiclass" else P("dp")
     return jax.jit(shard_map(
         fn, mesh=mesh,
-        in_specs=(P("dp", None), P("dp"), P("dp"), P()),
-        out_specs=(P(), P(), P())))
-
-
-def _mesh_boost(mesh, bins, yv, w, n_rounds, depth, n_bins, n_nodes,
-                objective, k, lr, reg_lambda, min_split_gain,
-                min_child_weight, base, min_child_samples):
-    """Boosting with rows sharded over the mesh's dp axis: every device
-    histograms its row shard, the histograms (and leaf sums) psum over ICI,
-    and all devices derive identical trees — the TPU replacement for the
-    reference's executor-parallel training (model.py:817-926, SURVEY P2)."""
-    from delphi_tpu.parallel.mesh import shard_rows
-
-    step = _mesh_boost_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective,
-                          k, float(lr), float(reg_lambda),
-                          float(min_split_gain), float(min_child_weight),
-                          float(min_child_samples))
-    return step(shard_rows(bins, mesh), shard_rows(yv, mesh),
-                shard_rows(w, mesh), jnp.asarray(base))
+        in_specs=(P("dp", None), P("dp"), P("dp"), F_spec),
+        out_specs=(F_spec, (P(), P(), P()))))
 
 
 @lru_cache(maxsize=128)
@@ -361,63 +388,125 @@ def _mesh_predict(mesh, bins, feats, thrs, leaves, n_rounds, depth,
 # Batched cross-validation grid search
 # ---------------------------------------------------------------------------
 
+def _cv_stats(F, y, val_mask, y_cmp, log_flag, cw_corr, class_valid,
+              objective, kk, axis_name):
+    """On-device CV scoring statistics from the boosting margin carry:
+    a [kk, kk] confusion-count matrix over the held-out rows for
+    classifiers (val_mask picks the fold's real rows; padding rows carry
+    mask 0), or [sse, count] for regressors — tiny tensors, so early
+    stopping never fetches full prediction vectors to the host."""
+    if objective == "regression":
+        pred = jnp.where(log_flag > 0, jnp.expm1(F), F)
+        out = jnp.stack([jnp.sum(val_mask * (pred - y_cmp) ** 2),
+                         jnp.sum(val_mask)])
+    else:
+        if objective == "binary":
+            p = jax.nn.sigmoid(F)
+            # deploy-parity: importance-correct back to true priors before
+            # the argmax, exactly as predict_proba does
+            pred = (p / cw_corr[1] > (1 - p) / cw_corr[0]).astype(jnp.int32)
+        else:
+            logp = jax.nn.log_softmax(F, axis=1)  # [n, k]
+            adj = logp - jnp.log(cw_corr)[None, :]
+            adj = jnp.where(class_valid[None, :] > 0, adj, -jnp.inf)
+            pred = jnp.argmax(adj, axis=1).astype(jnp.int32)
+        idx = y.astype(jnp.int32) * kk + pred
+        out = jax.ops.segment_sum(val_mask, idx,
+                                  num_segments=kk * kk).reshape(kk, kk)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
+
+
 @lru_cache(maxsize=128)
-def _cv_fold_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k):
-    """One CV launch: all configs of a (depth, rounds) group train against
-    ONE fold's shared bin/target/weight tensors, vmapped over the scalar
-    hyperparameters only. Sharing the fold tensors lets XLA emit
-    shared-rhs batched matmuls for the histogram contractions (one bin1h
-    read serves every config) instead of per-instance reads. Under a mesh,
-    rows shard over dp with psum'd histograms (reference P2, the pandas-UDF
-    training fan-out, train.py:163-209 / model.py:817-926)."""
+def _cv_chunk_fn(mesh, chunk, depth, n_bins, n_nodes, objective, k):
+    """One early-stopping CV step: every (fold, config) instance of a shape
+    group advances ``chunk`` boosting rounds from its carried margin state
+    and scores its held-out rows on device. Sharing the fold tensors lets
+    XLA emit shared-rhs batched contractions for the histograms (one bin
+    one-hot read serves every config). Under a mesh, rows shard over dp
+    with psum'd histograms (reference P2, the pandas-UDF training fan-out,
+    train.py:163-209 / model.py:817-926)."""
     axis_name = "dp" if mesh is not None else None
+    kk = 2 if objective == "binary" else max(k, 1)
 
-    def fn(bins, y_, weight, lrs, reg_lambdas, min_split_gains,
-           min_child_weights, base):
-        def one(lr, reg_lambda, min_split_gain, min_child_weight):
-            trees = _boost(bins, y_, weight, n_rounds, depth, n_bins,
-                           n_nodes, objective, k, lr, reg_lambda,
-                           min_split_gain, min_child_weight, base, 0.0,
-                           axis_name=axis_name)
-            return _predict_boosted(bins, *trees, n_rounds, depth, objective,
-                                    k, base, axis_name=axis_name)
+    def fn(bins, y_, weight, val_mask, y_cmp, log_flag, cw_corr, class_valid,
+           F, lrs, reg_lambdas, min_split_gains, min_child_weights):
+        def one(F1, lr, reg_lambda, min_split_gain, min_child_weight):
+            F2 = _boost(bins, y_, weight, F1, chunk, depth, n_bins, n_nodes,
+                        objective, k, lr, reg_lambda, min_split_gain,
+                        min_child_weight, 0.0, axis_name=axis_name,
+                        collect_trees=False)
+            stats = _cv_stats(F2, y_, val_mask, y_cmp, log_flag, cw_corr,
+                              class_valid, objective, kk, axis_name)
+            return F2, stats
 
-        return jax.vmap(one)(lrs, reg_lambdas, min_split_gains,
+        return jax.vmap(one)(F, lrs, reg_lambdas, min_split_gains,
                              min_child_weights)
 
     if mesh is None:
         # Single device: batch the FOLD axis into the same launch too —
-        # (folds × configs) instances train in one XLA program, one device
-        # round-trip per shape group instead of one per (group, fold).
+        # (folds × configs) instances advance in one XLA program per chunk.
         return jax.jit(jax.vmap(
-            fn, in_axes=(0, 0, 0, None, None, None, None, 0)))
+            fn, in_axes=(0, 0, 0, 0, None, 0, None, None, 0,
+                         None, None, None, None)))
 
     from jax.sharding import PartitionSpec as P
 
     from delphi_tpu.parallel.mesh import shard_map
 
-    out_spec = P(None, None, "dp") if objective == "multiclass" \
+    F_spec = P(None, "dp", None) if objective == "multiclass" \
         else P(None, "dp")
     return jax.jit(shard_map(
         fn, mesh=mesh,
-        in_specs=(P("dp", None), P("dp"), P("dp"), P(), P(), P(), P(), P()),
-        out_specs=out_spec))
+        in_specs=(P("dp", None), P("dp"), P("dp"), P("dp"), P("dp"), P(),
+                  P(), P(), F_spec, P(), P(), P(), P()),
+        out_specs=(F_spec, P())))
+
+
+def _f1_from_confusion(conf: np.ndarray, k_real: int) -> float:
+    """Macro-F1 from a confusion-count matrix, averaging over the classes
+    present in the fold's truth — identical semantics to
+    ``encoding.f1_macro`` (classes = unique(y_true))."""
+    conf = np.asarray(conf, np.float64)[:k_real, :k_real]
+    truth_counts = conf.sum(axis=1)
+    f1s = []
+    for c in range(k_real):
+        if truth_counts[c] <= 0:
+            continue
+        tp = conf[c, c]
+        fp = conf[:, c].sum() - tp
+        fn = truth_counts[c] - tp
+        p = tp / (tp + fp) if tp + fp > 0 else 0.0
+        r = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f1s.append(2 * p * r / (p + r) if p + r > 0 else 0.0)
+    return float(np.mean(f1s)) if f1s else 0.0
 
 
 def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                         configs: List[dict], n_splits: int,
                         class_weight: str,
                         template: "GradientBoostedTreesModel",
-                        timeout_s: float = 0.0) -> Tuple[int, float]:
+                        timeout_s: float = 0.0) -> Tuple[int, float, int]:
     """K-fold CV over a hyperparameter grid in one batched device launch per
-    static-shape group (configs sharing tree depth and round count vmap
-    together; others get their own launch).
+    static-shape group (configs sharing tree depth vmap together; others get
+    their own launches), with chunked EARLY STOPPING: boosting advances in
+    ``_CHUNK_ROUNDS``-round chunks, each chunk scores every instance's
+    held-out rows on device (confusion counts / SSE — no prediction fetch),
+    and a group stops once no config has improved for two consecutive
+    chunks — LightGBM's ``early_stopping_rounds`` semantics (reference
+    train.py:193-200) at chunk granularity.
 
-    Returns (best config index, its mean CV score). Scores match the
-    sequential path's metrics: macro-F1 for classifiers, -MSE for regressors
-    (the scorers the reference feeds hyperopt, train.py:158). Each fold bins
-    (and, for regression, log-transforms) from its training rows only, so an
-    instance's scores match a standalone per-fold fit.
+    Returns (best config index, its mean CV score, best round count); the
+    round count is the SMALLEST checkpoint where the winning config reached
+    its best score, so the final fit trains only as many rounds as CV
+    proved useful instead of the full round cap.
+
+    Scores match the sequential path's metrics: macro-F1 for classifiers,
+    -MSE for regressors (the scorers the reference feeds hyperopt,
+    train.py:158). Each fold bins (and, for regression, log-transforms)
+    from its training rows only, so an instance's scores match a
+    standalone per-fold fit.
 
     ``timeout_s`` > 0 bounds the search like the reference's hyperopt
     timeout (train.py:196): once exceeded, the best config so far wins.
@@ -446,11 +535,21 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
             objective = "multiclass"
             k = next(b for b in (4, 8, 16, 24, MAX_MULTICLASS) if b >= k_real)
         yv = codes.astype(np.float32)
+        kk = 2 if objective == "binary" else k
+        cw_corr = np.ones(kk, np.float32)
+        if per_class_w is not None:
+            m = min(k_real, kk)
+            cw_corr[:m] = per_class_w[:m]
+        class_valid = (np.arange(kk) < k_real).astype(np.float32)
+        y_cmp = np.zeros(n, np.float32)  # unused for classifiers
     else:
         objective, k, k_real = "regression", 1, 0
         yv64 = pd.to_numeric(pd.Series(y_arr), errors="coerce") \
             .to_numpy(dtype=np.float64)
         w_full = np.ones(n)
+        cw_corr = np.ones(1, np.float32)
+        class_valid = np.ones(1, np.float32)
+        y_cmp = yv64.astype(np.float32)  # original-space comparison target
 
     def cfg_depth(cfg: dict) -> int:
         return int(cfg.get("max_depth", template.max_depth))
@@ -468,6 +567,8 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
 
     from delphi_tpu.parallel.mesh import get_active_mesh
     mesh = get_active_mesh()
+    n_pad = template._pad(np.zeros(n, np.float32), mesh=mesh,
+                          train=True).shape[0]
 
     # Per-fold preprocessing matches a standalone fit on the fold's training
     # rows exactly: bin edges (and, for regression, the log-target decision)
@@ -479,9 +580,9 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
         train_mask[fold] = False
         binner_f = _Binner(template.max_bin).fit(Xm[train_mask])
         fold_bins.append(template._pad(template._pad_feature_dim(
-            binner_f.transform(Xm)), mesh=mesh))
+            binner_f.transform(Xm)), mesh=mesh, train=True))
         if is_discrete:
-            fold_y.append(template._pad(yv, mesh=mesh))
+            fold_y.append(template._pad(yv, mesh=mesh, train=True))
             fold_log.append(False)
         else:
             ytr = yv64[train_mask]
@@ -490,26 +591,19 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                 if std > 0 else 0.0
             log_f = bool((ytr >= 0).all() and skew > 2.0)
             yv_f = (np.log1p(yv64) if log_f else yv64).astype(np.float32)
-            fold_y.append(template._pad(yv_f, mesh=mesh))
+            fold_y.append(template._pad(yv_f, mesh=mesh, train=True))
             fold_log.append(log_f)
 
-    # Configs sharing (depth, rounds) vmap into one launch; configs that
-    # differ in those STATIC dims (tree tensor shapes change) form separate
-    # groups, each still a single launch — every config is trained with its
-    # own true hyperparameters.
-    from delphi_tpu.models.encoding import f1_macro
-
+    # Configs sharing (depth, round cap) advance together; configs that
+    # differ in those STATIC dims form separate groups, each chunk still a
+    # single launch — every config is trained with its own true
+    # hyperparameters.
     groups: Dict[Tuple[int, int], List[int]] = {}
     for ci, cfg in enumerate(configs):
         groups.setdefault((cfg_depth(cfg), cfg_rounds(cfg)), []).append(ci)
 
-    # Deploy-parity scoring uses per_class_w (computed with w_full above, see
-    # _recalibrate): balanced training weights are importance-corrected back
-    # to the true priors before the argmax, exactly as predict_proba does, so
-    # CV ranks configs by deployed behavior.
-
-    # Per-fold tensors (weights, base scores, device placement) are group-
-    # independent: prepare and place them once, then reuse across groups.
+    # Per-fold tensors (weights, base scores, validation masks, device
+    # placement) are group-independent: prepare and place them once.
     fold_prep = []
     for fi, fold in enumerate(folds):
         train_mask = np.ones(n, dtype=bool)
@@ -531,121 +625,148 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
             base = np.array(
                 [float((w * yv_f).sum() / max(w.sum(), 1e-9))], np.float32)
 
-        bins_dev: Any = fold_bins[fi]
-        y_dev: Any = fold_y[fi]
-        w_dev: Any = template._pad(w, mesh=mesh)
-        if mesh is not None:
-            from delphi_tpu.parallel.mesh import shard_rows
-            bins_dev = shard_rows(bins_dev, mesh)
-            y_dev = shard_rows(y_dev, mesh)
-            w_dev = shard_rows(w_dev, mesh)
-        else:
-            bins_dev = jnp.asarray(bins_dev)
-            y_dev = jnp.asarray(y_dev)
-            w_dev = jnp.asarray(w_dev)
-        fold_prep.append((fi, fold, bins_dev, y_dev, w_dev,
-                          jnp.asarray(base)))
+        val = np.zeros(n_pad, np.float32)
+        val[fold] = 1.0
+        fold_prep.append((fi, fold, fold_bins[fi], fold_y[fi],
+                          template._pad(w, mesh=mesh, train=True), val,
+                          base))
 
-    per_config: Dict[int, List[float]] = {}
+    if not fold_prep:
+        return 0, -np.inf, 0
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(arr, spec):
+        if mesh is None:
+            return jnp.asarray(arr)
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(
+                arr.shape, sharding,
+                lambda idx: np.ascontiguousarray(np.asarray(arr)[idx]))
+        return jax.device_put(np.asarray(arr), sharding)
+
+    y_cmp_dev = place(template._pad(y_cmp, mesh=mesh, train=True), P("dp"))
+    cw_dev = jnp.asarray(cw_corr)
+    valid_dev = jnp.asarray(class_valid)
+
+    if mesh is None:
+        bins_dev = jnp.stack([jnp.asarray(p[2]) for p in fold_prep])
+        ys_dev = jnp.stack([jnp.asarray(p[3]) for p in fold_prep])
+        ws_dev = jnp.stack([jnp.asarray(p[4]) for p in fold_prep])
+        vals_dev = jnp.stack([jnp.asarray(p[5]) for p in fold_prep])
+    else:
+        bins_dev = [place(p[2], P("dp", None)) for p in fold_prep]
+        ys_dev = [place(p[3], P("dp")) for p in fold_prep]
+        ws_dev = [place(p[4], P("dp")) for p in fold_prep]
+        vals_dev = [place(p[5], P("dp")) for p in fold_prep]
+    logs_np = np.asarray(
+        [1.0 if fold_log[p[0]] else 0.0 for p in fold_prep], np.float32)
+
+    # best (score, rounds) per config; rounds = smallest checkpoint at the
+    # config's best score (strict-improvement updates keep it minimal)
+    best_by_cfg: Dict[int, Tuple[float, int]] = {}
     timed_out = False
-    for (g_depth, g_rounds), cfg_indices in groups.items():
-        if timed_out:
-            break
-        lrs = np.asarray([configs[ci].get("learning_rate", 0.1)
-                          for ci in cfg_indices], np.float32)
-        regs = np.asarray([configs[ci].get("reg_lambda", 1.0)
-                           for ci in cfg_indices], np.float32)
-        msgs = np.asarray([template.min_split_gain] * len(cfg_indices),
-                          np.float32)
-        mcws = np.asarray([configs[ci].get("min_child_weight", 1.0)
-                           for ci in cfg_indices], np.float32)
-        fn = _cv_fold_fn(mesh, g_rounds, g_depth, n_bins, 1 << g_depth,
-                         objective, k)
+    stop_all = False
+    patience_chunks = 2
+    eps = 1e-12
+    F_spec_m = P(None, "dp", None) if objective == "multiclass" \
+        else P(None, "dp")
 
-        if not fold_prep:
-            fold_results = []
-        elif mesh is None:
-            # One launch per shape group: every (fold, config) instance of
-            # the group trains in a single XLA program (fn vmaps the fold
-            # axis), so the group costs one device round-trip. Timeout
-            # granularity is per group — all of a group's configs get all
-            # folds or none, which keeps the fair-mean property below.
+    for (g_depth, g_rounds), cfg_indices in groups.items():
+        if timed_out or stop_all:
+            break
+        n_cfg = len(cfg_indices)
+        lrs = jnp.asarray([configs[ci].get("learning_rate", 0.1)
+                           for ci in cfg_indices], jnp.float32)
+        regs = jnp.asarray([configs[ci].get("reg_lambda", 1.0)
+                            for ci in cfg_indices], jnp.float32)
+        msgs = jnp.asarray([template.min_split_gain] * n_cfg, jnp.float32)
+        mcws = jnp.asarray([configs[ci].get("min_child_weight", 1.0)
+                            for ci in cfg_indices], jnp.float32)
+
+        # margin carries, one per (fold, config) instance
+        if mesh is None:
+            F = jnp.stack([
+                jnp.broadcast_to(
+                    jnp.asarray(_init_margin(p[6], n_pad, objective, k)),
+                    (n_cfg,) + ((n_pad, k) if objective == "multiclass"
+                                else (n_pad,)))
+                for p in fold_prep])
+        else:
+            F = [place(np.broadcast_to(
+                    _init_margin(p[6], n_pad, objective, k),
+                    (n_cfg,) + ((n_pad, k) if objective == "multiclass"
+                                else (n_pad,))).copy(), F_spec_m)
+                 for p in fold_prep]
+
+        rounds_done = 0
+        no_improve = 0
+        for chunk in _round_chunks(g_rounds):
             if deadline is not None and time.monotonic() > deadline:
                 timed_out = True
                 break
-            Fg = fn(jnp.stack([p[2] for p in fold_prep]),
-                    jnp.stack([p[3] for p in fold_prep]),
-                    jnp.stack([p[4] for p in fold_prep]),
-                    jnp.asarray(lrs), jnp.asarray(regs), jnp.asarray(msgs),
-                    jnp.asarray(mcws),
-                    jnp.stack([p[5] for p in fold_prep]))
-            # [n_folds, n_cfg, (k,) n]
-            Fg = np.asarray(jax.device_get(Fg))[..., :n]
-            fold_results = [(p[0], p[1], Fg[i])
-                            for i, p in enumerate(fold_prep)]
-        else:
-            fold_results = []
-            for fi, fold, bins_dev, y_dev, w_dev, base_dev in fold_prep:
-                if deadline is not None and time.monotonic() > deadline:
-                    timed_out = True
-                    break
-                F = fn(bins_dev, y_dev, w_dev, jnp.asarray(lrs),
-                       jnp.asarray(regs), jnp.asarray(msgs),
-                       jnp.asarray(mcws), base_dev)
-                # [n_cfg, (k,) n]
-                fold_results.append(
-                    (fi, fold, np.asarray(jax.device_get(F))[..., :n]))
+            fn = _cv_chunk_fn(mesh, chunk, g_depth, n_bins, 1 << g_depth,
+                              objective, k)
+            if mesh is None:
+                # one launch advances every (fold, config) instance
+                F, stats = fn(bins_dev, ys_dev, ws_dev, vals_dev, y_cmp_dev,
+                              jnp.asarray(logs_np), cw_dev, valid_dev, F,
+                              lrs, regs, msgs, mcws)
+                stats_np = np.asarray(jax.device_get(stats))
+            else:
+                stats_parts = []
+                for i in range(len(fold_prep)):
+                    F[i], s = fn(bins_dev[i], ys_dev[i], ws_dev[i],
+                                 vals_dev[i], y_cmp_dev,
+                                 jnp.float32(logs_np[i]), cw_dev, valid_dev,
+                                 F[i], lrs, regs, msgs, mcws)
+                    stats_parts.append(np.asarray(jax.device_get(s)))
+                stats_np = np.stack(stats_parts)  # [n_folds, n_cfg, ...]
+            rounds_done += chunk
 
-        for fi, fold, F in fold_results:
+            improved = False
             for j, ci in enumerate(cfg_indices):
-                if is_discrete:
-                    if objective == "multiclass":
-                        z = F[j][:k_real, fold]
-                        z = z - z.max(axis=0, keepdims=True)
-                        probs = np.exp(z)
-                        probs /= np.maximum(probs.sum(axis=0, keepdims=True),
-                                            1e-12)
+                fold_scores = []
+                for i in range(len(fold_prep)):
+                    s = stats_np[i, j]
+                    if is_discrete:
+                        fold_scores.append(_f1_from_confusion(s, k_real))
                     else:
-                        p = 1.0 / (1.0 + np.exp(-F[j][fold]))
-                        probs = np.stack([1 - p, p])[:k_real]
-                    if per_class_w is not None:
-                        probs = probs / np.maximum(
-                            per_class_w[:probs.shape[0], None], 1e-12)
-                    pred_codes = probs.argmax(axis=0)
-                    truth = y_arr[fold].astype(str)
-                    pred = classes[np.minimum(pred_codes,
-                                              k_real - 1)].astype(str)
-                    score = f1_macro(truth, pred)
-                else:
-                    pred = F[j][fold]
-                    if fold_log[fi]:
-                        pred = np.expm1(pred)
-                    score = -float(((pred - yv64[fold]) ** 2).mean())
-                per_config.setdefault(ci, []).append(score)
+                        fold_scores.append(-float(s[0] / max(s[1], 1.0)))
+                mean = float(np.mean(fold_scores))
+                prev = best_by_cfg.get(ci, (-np.inf, 0))[0]
+                if mean > prev + eps:
+                    best_by_cfg[ci] = (mean, rounds_done)
+                    improved = True
+                # Early exit on a PERFECT classifier score: a config at
+                # macro-F1 1.0 on every fold cannot be beaten — remaining
+                # chunks AND groups are pure cost (on easy targets like
+                # hospital State this halves the search).
+                if is_discrete and min(fold_scores) >= 1.0 - 1e-12:
+                    stop_all = True
+            if stop_all:
+                break
+            if improved:
+                no_improve = 0
+            else:
+                no_improve += 1
+                if no_improve >= patience_chunks:
+                    break
 
-        # Early exit on a PERFECT classifier score: a config already at
-        # macro-F1 1.0 on every fold cannot be beaten, so the remaining
-        # shape groups' launches are pure cost (on easy targets like
-        # hospital State this halves the search). Only the group just
-        # scored can newly qualify.
-        if is_discrete and any(
-                len(per_config.get(ci, ())) == len(fold_prep)
-                and min(per_config[ci]) >= 1.0 - 1e-12
-                for ci in cfg_indices):
+        # Good-enough group stop: once some config's CV macro-F1 clears
+        # 0.995, later shape groups can improve the mean by at most 0.005 —
+        # not worth their launches (repair picks argmax cells; such targets
+        # are already essentially solved).
+        if is_discrete and best_by_cfg and \
+                max(s for s, _ in best_by_cfg.values()) >= 0.995:
             break
 
-    if not per_config:
-        return 0, -np.inf
-    if timed_out:
-        # a timeout mid-group leaves some configs scored on fewer folds; a
-        # lucky partial mean must not beat a full-CV mean (the reference's
-        # hyperopt timeout likewise only counts finished trials)
-        max_folds = max(len(s) for s in per_config.values())
-        per_config = {ci: s for ci, s in per_config.items()
-                      if len(s) == max_folds}
-    mean_scores = {ci: float(np.mean(s)) for ci, s in per_config.items()}
-    best_ci = max(mean_scores, key=lambda ci: mean_scores[ci])
-    return best_ci, mean_scores[best_ci]
+    if not best_by_cfg:
+        return 0, -np.inf, 0
+    best_ci = max(best_by_cfg, key=lambda ci: best_by_cfg[ci][0])
+    best_score, best_rounds = best_by_cfg[best_ci]
+    return best_ci, best_score, best_rounds
 
 
 # ---------------------------------------------------------------------------
@@ -687,13 +808,17 @@ class GradientBoostedTreesModel:
         return np.asarray(X, dtype=np.float64)
 
     @staticmethod
-    def _pad(arr: np.ndarray, value: float = 0, mesh: Any = None) -> np.ndarray:
+    def _pad(arr: np.ndarray, value: float = 0, mesh: Any = None,
+             train: bool = False) -> np.ndarray:
         """Pads rows to the next power of two so fold/dataset size changes
         don't trigger XLA recompilation; under an active mesh, also to a
-        multiple of the dp size so row shards are equal."""
+        multiple of the dp size so row shards are equal. ``train=True``
+        switches to the finer training-row target (see
+        :func:`train_row_target`)."""
         from delphi_tpu.parallel.mesh import padded_row_target
         n = arr.shape[0]
-        target = padded_row_target(n, mesh)
+        target = train_row_target(n, mesh) if train \
+            else padded_row_target(n, mesh)
         if target == n:
             return arr
         pad_shape = (target - n,) + arr.shape[1:]
@@ -720,7 +845,7 @@ class GradientBoostedTreesModel:
         n, d = Xm.shape
         self._binner = _Binner(self.max_bin).fit(Xm)
         bins_np = self._pad(self._pad_feature_dim(
-            self._binner.transform(Xm)), mesh=mesh)
+            self._binner.transform(Xm)), mesh=mesh, train=True)
         self._n_bins = self._binner.n_bins
         self._n_nodes = 1 << self.max_depth
 
@@ -780,27 +905,50 @@ class GradientBoostedTreesModel:
             self._classes = np.array([])
 
         self._base = base
-        yv_p = self._pad(np.asarray(yv, np.float32), mesh=mesh)
-        w_p = self._pad(np.asarray(w, np.float32), mesh=mesh)
+        yv_p = self._pad(np.asarray(yv, np.float32), mesh=mesh, train=True)
+        w_p = self._pad(np.asarray(w, np.float32), mesh=mesh, train=True)
         # Optional leaf row-count floor (LightGBM's min_child_samples).
         # Default 0: prior recalibration in predict_proba already guards
         # against upweighted rare typo classes, and a hard floor costs
         # accuracy on tight local structure (e.g. boston RAD).
         mcs = self.min_child_samples if self.is_discrete else 0.0
+        # Chunked fit: the margin carry stays on device between fixed-size
+        # chunk launches, so any CV-selected round count (the early-stopping
+        # driver below) reuses the SAME compiled chunk program instead of
+        # compiling one scan per distinct n_estimators.
+        F = _init_margin(base, bins_np.shape[0], self._objective,
+                         max(self._k, 1))
+        parts: List[Any] = []
         if mesh is not None:
-            trees = _mesh_boost(
-                mesh, bins_np, yv_p, w_p, self.n_estimators, self.max_depth,
-                self._n_bins, self._n_nodes, self._objective, max(self._k, 1),
-                self.learning_rate, self.reg_lambda, self.min_split_gain,
-                self.min_child_weight, base, mcs)
+            from delphi_tpu.parallel.mesh import shard_rows
+            bins_dev = shard_rows(bins_np, mesh)
+            y_dev = shard_rows(yv_p, mesh)
+            w_dev = shard_rows(w_p, mesh)
+            F = shard_rows(F, mesh)
+            for chunk in _round_chunks(self.n_estimators):
+                step = _mesh_boost_fn(
+                    mesh, chunk, self.max_depth, self._n_bins, self._n_nodes,
+                    self._objective, max(self._k, 1),
+                    float(self.learning_rate), float(self.reg_lambda),
+                    float(self.min_split_gain), float(self.min_child_weight),
+                    float(mcs))
+                F, trees = step(bins_dev, y_dev, w_dev, F)
+                parts.append(trees)
         else:
-            trees = _boost(
-                jnp.asarray(bins_np), jnp.asarray(yv_p), jnp.asarray(w_p),
-                self.n_estimators, self.max_depth, self._n_bins,
-                self._n_nodes, self._objective, max(self._k, 1),
-                self.learning_rate, self.reg_lambda, self.min_split_gain,
-                self.min_child_weight, jnp.asarray(base), mcs)
-        self._trees = jax.device_get(trees)
+            bins_dev = jnp.asarray(bins_np)
+            y_dev = jnp.asarray(yv_p)
+            w_dev = jnp.asarray(w_p)
+            F = jnp.asarray(F)
+            for chunk in _round_chunks(self.n_estimators):
+                F, trees = _boost(
+                    bins_dev, y_dev, w_dev, F, chunk, self.max_depth,
+                    self._n_bins, self._n_nodes, self._objective,
+                    max(self._k, 1), self.learning_rate, self.reg_lambda,
+                    self.min_split_gain, self.min_child_weight, mcs)
+                parts.append(trees)
+        parts = [jax.device_get(t) for t in parts]
+        self._trees = tuple(
+            np.concatenate([p[i] for p in parts], axis=0) for i in range(3))
         return self
 
     def _raw_scores(self, X: Any) -> np.ndarray:

@@ -23,9 +23,12 @@ import pandas as pd
 
 
 def _pad_rows(X: np.ndarray, *arrays: np.ndarray, mesh: Any = None):
-    from delphi_tpu.parallel.mesh import padded_row_target
+    # training-row pad target: fits are capped by model.max_training_row_num,
+    # so the finer granularity saves real FLOPs (10000 -> 10240, not 16384)
+    # without multiplying compiled variants
+    from delphi_tpu.models.gbdt import train_row_target
     n = X.shape[0]
-    padded = padded_row_target(n, mesh)
+    padded = train_row_target(n, mesh)
     if padded == n:
         mask = np.ones(n, dtype=np.float32)
         return X, arrays, mask
@@ -105,6 +108,56 @@ def _fit_logreg(X, y, mask, class_weights, l2, lr, n_steps, axis_name=None):
 
     _, params, _, _, last_loss = jax.lax.while_loop(
         cond, body, (jnp.int32(0), (W, b), state,
+                     jnp.float32(jnp.inf), jnp.float32(jnp.inf)))
+    return params, last_loss
+
+
+@partial(jax.jit, static_argnames=("n_steps", "n_vocab"))
+def _fit_logreg_gather(gid, cont, fmask, y, mask, class_weights, l2, lr,
+                       n_steps, n_vocab):
+    """The logistic head on the FACTORED one-hot design (OneHotDesign):
+    ``X @ W`` over a block-one-hot matrix is an embedding gather, so the
+    per-step cost drops from O(n * D * k) matmul FLOPs to O(n * F * k)
+    gathers (D = summed vocab width, F = feature count). Identical
+    objective, weights and convergence rule as `_fit_logreg` — the dense
+    matmul IS this gather, so both paths optimize the same loss surface.
+    Used on CPU hosts where the dense one-hot matmul dominates phase 2;
+    accelerators keep the dense MXU path."""
+    n, fc = gid.shape
+    k = class_weights.shape[0]
+    Wcat = jnp.zeros((n_vocab, k), dtype=jnp.float32)
+    Wcont = jnp.zeros((cont.shape[1], k), dtype=jnp.float32)
+    b = jnp.zeros((k,), dtype=jnp.float32)
+    opt = optax.adam(lr)
+    state = opt.init((Wcat, Wcont, b))
+    sample_w = mask * class_weights[y]
+    denom = jnp.maximum(sample_w.sum(), 1.0)
+
+    def loss_fn(params):
+        Wcat, Wcont, b = params
+        g = (Wcat[gid] * fmask[None, :, None]).sum(axis=1)  # [n, k]
+        logits = g + cont @ Wcont + b
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        return (sample_w * nll).sum() / denom \
+            + l2 * (jnp.sum(Wcat * Wcat) + jnp.sum(Wcont * Wcont))
+
+    tol = 1e-6
+
+    def cond(carry):
+        i, _, _, prev, cur = carry
+        return (i < n_steps) & ((i < 20) |
+                                (jnp.abs(prev - cur) > tol * (1.0 + jnp.abs(cur))))
+
+    def body(carry):
+        i, params, state, _, cur = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state)
+        params = optax.apply_updates(params, updates)
+        return i + 1, params, state, cur, loss
+
+    _, params, _, _, last_loss = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), (Wcat, Wcont, b), state,
                      jnp.float32(jnp.inf), jnp.float32(jnp.inf)))
     return params, last_loss
 
@@ -211,7 +264,9 @@ class LogisticRegressionModel:
         assert self._classes is not None
         return self._classes
 
-    def fit(self, X: np.ndarray, y: "pd.Series") -> "LogisticRegressionModel":
+    def fit(self, X: Any, y: "pd.Series") -> "LogisticRegressionModel":
+        from delphi_tpu.models.encoding import OneHotDesign
+
         codes, classes = pd.factorize(np.asarray(y), sort=True)
         assert (codes >= 0).all(), "y must not contain NULLs"
         self._classes = np.asarray(classes)
@@ -228,6 +283,15 @@ class LogisticRegressionModel:
 
         from delphi_tpu.parallel.mesh import get_active_mesh
         mesh = get_active_mesh()
+        self._compact = None
+        import os
+        if isinstance(X, OneHotDesign) and X.cat_idx.shape[1] > 0 \
+                and mesh is None and jax.default_backend() == "cpu" \
+                and os.environ.get("DELPHI_DENSE_LOGREG") != "1":
+            self._fit_compact(X, codes, class_weights)
+            return self
+        if isinstance(X, OneHotDesign):
+            X = X.dense()  # accelerators keep the dense MXU matmul path
         Xp, (yp,), mask = _pad_rows(_pad_cols(np.asarray(X, np.float32)),
                                     codes.astype(np.int32), mesh=mesh)
         if mesh is not None:
@@ -242,11 +306,73 @@ class LogisticRegressionModel:
         self.loss_ = float(loss)
         return self
 
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+    def _fit_compact(self, X: Any, codes: np.ndarray,
+                     class_weights: np.ndarray) -> None:
+        """Gather-path training from a OneHotDesign (CPU hosts)."""
+        sizes = np.asarray(X.cat_sizes, np.int64)
+        offsets = np.concatenate([[0], np.cumsum(sizes[:-1])])
+        gid = (offsets[None, :] + X.cat_idx).astype(np.int32)
+        n, fc = gid.shape
+        # pad features to a multiple of 4 and the vocab to a power of two so
+        # per-attribute fits share compiled programs; padded feature slots
+        # point at row 0 with fmask 0 (no logit contribution)
+        fc_pad = max(4, -(-fc // 4) * 4)
+        if fc_pad != fc:
+            gid = np.concatenate(
+                [gid, np.zeros((n, fc_pad - fc), np.int32)], axis=1)
+        fmask = (np.arange(fc_pad) < fc).astype(np.float32)
+        v = int(sizes.sum())
+        v_pad = max(16, 1 << (v - 1).bit_length())
+        cont = _pad_cols(X.cont) if X.cont.shape[1] else \
+            np.zeros((n, 8), np.float32)
+        gid_p, (yp, cont_p), mask = _pad_rows(gid, codes.astype(np.int32),
+                                              cont)
+        params, loss = _fit_logreg_gather(
+            jnp.asarray(gid_p), jnp.asarray(cont_p), jnp.asarray(fmask),
+            jnp.asarray(yp), jnp.asarray(mask), jnp.asarray(class_weights),
+            self.l2, self.lr, self.n_steps, v_pad)
+        self._compact = {
+            "offsets": offsets, "sizes": sizes, "fc": fc, "fc_pad": fc_pad,
+            "layout": X.layout, "width": X.width,
+        }
+        self._params = jax.device_get(params)
+        self.loss_ = float(loss)
+
+    def _dense_weights(self) -> Any:
+        """Dense-equivalent (W, b) reconstructed from gather-path params via
+        the recorded design layout (for callers handing in dense arrays)."""
+        Wcat, Wcont, b = self._params
+        c = self._compact
+        W = np.zeros((c["width"], Wcat.shape[1]), np.float32)
+        for kind, start, slot in c["layout"]:
+            if kind == "cat":
+                o = int(c["offsets"][slot])
+                W[start:start + int(c["sizes"][slot])] = \
+                    Wcat[o:o + int(c["sizes"][slot])]
+            else:
+                W[start] = Wcont[slot]
+        return W, b
+
+    def predict_proba(self, X: Any) -> np.ndarray:
+        from delphi_tpu.models.encoding import OneHotDesign
         assert self._params is not None
-        W, b = self._params
         k = len(self.classes_)
-        logits = _pad_cols(np.asarray(X, np.float32)) @ W + b
+        if getattr(self, "_compact", None) is not None:
+            if isinstance(X, OneHotDesign):
+                Wcat, Wcont, b = self._params
+                c = self._compact
+                gid = (c["offsets"][None, :] + X.cat_idx).astype(np.int64)
+                logits = Wcat[gid].sum(axis=1) + b
+                if X.cont.shape[1]:
+                    logits = logits + X.cont @ Wcont[:X.cont.shape[1]]
+            else:
+                W, b = self._dense_weights()
+                logits = np.asarray(X, np.float32) @ W + b
+        else:
+            if isinstance(X, OneHotDesign):
+                X = X.dense()
+            W, b = self._params
+            logits = _pad_cols(np.asarray(X, np.float32)) @ W + b
         logits = logits[:, :k]  # drop padded bucket classes
         logits -= logits.max(axis=1, keepdims=True)
         e = np.exp(logits)
@@ -282,7 +408,10 @@ class MLPRegressorModel:
     def classes_(self) -> np.ndarray:
         return np.array([])
 
-    def fit(self, X: np.ndarray, y: "pd.Series") -> "MLPRegressorModel":
+    def fit(self, X: Any, y: "pd.Series") -> "MLPRegressorModel":
+        from delphi_tpu.models.encoding import OneHotDesign
+        if isinstance(X, OneHotDesign):
+            X = X.dense()
         yv = pd.to_numeric(pd.Series(np.asarray(y)), errors="coerce") \
             .to_numpy(dtype=np.float64)
         assert not np.isnan(yv).any(), "y must not contain NULLs"
@@ -298,7 +427,10 @@ class MLPRegressorModel:
         self.loss_ = float(loss)
         return self
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
+    def predict(self, X: Any) -> np.ndarray:
+        from delphi_tpu.models.encoding import OneHotDesign
+        if isinstance(X, OneHotDesign):
+            X = X.dense()
         assert self._params is not None
         pred = np.asarray(_mlp_forward(
             self._params,

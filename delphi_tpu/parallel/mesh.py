@@ -17,17 +17,24 @@ _active_mesh_cache: dict = {}
 
 def get_active_mesh() -> Optional[Mesh]:
     """The mesh the PIPELINE's stats kernels run on, or None for the
-    single-device path. Opt-in: set ``DELPHI_MESH=auto`` (all local devices
-    when more than one) or ``DELPHI_MESH=<n>`` (first n devices), or the
-    session config key ``repair.mesh`` with the same values. This is the
-    switch that turns the engine's reductions into psum'd SPMD programs
-    (SURVEY.md §2.3 P1) without touching user code."""
+    single-device path. DEFAULT-ON on the target hardware: with no setting,
+    a TPU backend exposing more than one device (or any multi-process
+    cluster) gets a mesh over all devices — the TPU-native path is the
+    default path on TPU. Override with ``DELPHI_MESH=auto`` (all local
+    devices when more than one), ``DELPHI_MESH=<n>`` (first n devices), or
+    ``DELPHI_MESH=off``; the session config key ``repair.mesh`` accepts the
+    same values. This is the switch that turns the engine's reductions into
+    psum'd SPMD programs (SURVEY.md §2.3 P1) without touching user code."""
     setting = os.environ.get("DELPHI_MESH", "")
     if not setting:
         from delphi_tpu.session import get_session
         setting = get_session().conf.get("repair.mesh", "")
     setting = setting.strip().lower()
-    if setting in ("", "0", "off", "none"):
+    if setting == "":
+        if "__default__" not in _active_mesh_cache:
+            _active_mesh_cache["__default__"] = _default_mesh()
+        return _active_mesh_cache["__default__"]
+    if setting in ("0", "off", "none"):
         return None
     if setting != "auto" and not setting.isdigit():
         raise ValueError(
@@ -47,6 +54,24 @@ def get_active_mesh() -> Optional[Mesh]:
             _active_mesh_cache[key] = make_mesh(
                 min(n_devices, available) if n_devices else None)
     return _active_mesh_cache[key]
+
+
+def _default_mesh() -> Optional[Mesh]:
+    """The no-configuration default: a dp mesh over all devices when the
+    backend is TPU with >1 device, or when running multi-process (where the
+    mesh is the only way the cluster's devices cooperate). CPU/GPU
+    single-process defaults stay single-device — virtual CPU meshes are a
+    TESTING construct, opted into via DELPHI_MESH."""
+    from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+    maybe_initialize_distributed()
+    try:
+        n = len(jax.devices())
+        backend = jax.default_backend()
+    except Exception:  # backend init failure -> single-device semantics
+        return None
+    if n > 1 and (backend == "tpu" or jax.process_count() > 1):
+        return make_mesh()
+    return None
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -166,8 +166,8 @@ def _refine_candidates(cfg: dict, seen: list, scale: int = 1) -> list:
     return uniq
 
 
-def _refine_best_config(X, y, is_discrete, best_cfg, best_score, grid,
-                        n_splits, class_weight, template, deadline,
+def _refine_best_config(X, y, is_discrete, best_cfg, best_score, best_rounds,
+                        grid, n_splits, class_weight, template, deadline,
                         no_progress_evals, explicit):
     """Adaptive second phase of the hyperparameter search, honoring
     `model.hp.no_progress_loss` (the reference's hyperopt early-stop,
@@ -185,9 +185,9 @@ def _refine_best_config(X, y, is_discrete, best_cfg, best_score, grid,
     if not explicit:
         import jax
         if jax.default_backend() == "cpu":
-            return best_cfg, best_score
+            return best_cfg, best_score, best_rounds
     if not np.isfinite(best_score):
-        return best_cfg, best_score
+        return best_cfg, best_score, best_rounds
 
     max_rounds = 5
     evals_no_progress = 0
@@ -201,7 +201,7 @@ def _refine_best_config(X, y, is_discrete, best_cfg, best_score, grid,
         if not candidates:
             break
         seen.extend(candidates)
-        ci, score = gbdt_cv_grid_search(
+        ci, score, rounds = gbdt_cv_grid_search(
             X, y, is_discrete, candidates, n_splits, class_weight, template,
             timeout_s=remaining if remaining is not None else 0.0)
         if score <= best_score:
@@ -215,8 +215,12 @@ def _refine_best_config(X, y, is_discrete, best_cfg, best_score, grid,
         _logger.info(
             f"Refinement improved CV score {best_score:.4f} -> {score:.4f} "
             f"({candidates[ci]})")
-        best_cfg, best_score = candidates[ci], score
-    return best_cfg, best_score
+        # candidates carry the GRID's round budget (best_cfg is never given
+        # the truncated count — a slower-learning candidate must be free to
+        # use more rounds than the incumbent's early stop chose); the
+        # winner's own CV-proven round count travels alongside
+        best_cfg, best_score, best_rounds = dict(candidates[ci]), score, rounds
+    return best_cfg, best_score, best_rounds
 
 
 @elapsed_time  # type: ignore
@@ -231,7 +235,9 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
         n_splits = int(opt(*_opt_n_splits))
         max_evals = int(opt(*_opt_max_evals))
         class_weight = str(opt(*_opt_class_weight))
-        X = np.asarray(X)
+        from delphi_tpu.models.encoding import OneHotDesign
+        if not isinstance(X, OneHotDesign):  # the linear heads take the
+            X = np.asarray(X)                # factored design as-is
 
         if gbdt_supported(is_discrete, num_class):
             def factory(cfg):
@@ -250,10 +256,17 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                     # Platform-aware search depth: on an accelerator the
                     # extra configs ride the same vmapped launches almost
                     # free, but on a CPU host every config costs real
-                    # sequential FLOPs — default to the 4 strongest configs
-                    # (the pre-widening grid) unless the caller raises
-                    # `model.hp.max_evals` explicitly.
-                    grid = grid[:4]
+                    # sequential FLOPs. Classifiers trim to the strongest
+                    # config per tree depth — their searches also early-exit
+                    # on perfect/near-perfect CV F1, and the hospital /
+                    # flights / adult gates hold at this width. Regressors
+                    # keep 4: RMSE gates (boston CRIM+RAD) are sensitive to
+                    # the reg_lambda/min_child_weight axis the 2-config trim
+                    # would drop, and regression targets are the minority.
+                    if is_discrete and len(grid) > 2:
+                        grid = [grid[0], grid[2]]
+                    else:
+                        grid = grid[:4]
             if is_discrete and num_class > 8:
                 # wide multiclass: CV grid search is too costly for the gain
                 grid = grid[:1]
@@ -268,15 +281,22 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 # refinement), like the reference's hyperopt timeout
                 deadline = time.monotonic() + timeout_s if timeout_s > 0 \
                     else None
-                best_ci, best_score = gbdt_cv_grid_search(
+                best_ci, best_score, best_rounds = gbdt_cv_grid_search(
                     X, y, is_discrete, grid, n_splits, class_weight, template,
                     timeout_s=timeout_s)
-                best_cfg = grid[best_ci]
-                best_cfg, best_score = _refine_best_config(
-                    X, y, is_discrete, best_cfg, best_score, grid, n_splits,
-                    class_weight, template, deadline,
+                best_cfg = dict(grid[best_ci])
+                best_cfg, best_score, best_rounds = _refine_best_config(
+                    X, y, is_discrete, best_cfg, best_score, best_rounds,
+                    grid, n_splits, class_weight, template, deadline,
                     no_progress_evals=int(opt(*_opt_no_progress_loss)),
                     explicit=_opt_no_progress_loss.key in opts)
+                if best_rounds > 0:
+                    # the final fit trains only as many rounds as CV proved
+                    # useful for the WINNING config (LightGBM
+                    # early_stopping_rounds semantics, reference
+                    # train.py:193-200); applied after refinement so
+                    # refinement candidates keep the full round budget
+                    best_cfg["n_estimators"] = best_rounds
             model = factory(best_cfg)()
             model.fit(X, y)
             return model, best_score if np.isfinite(best_score) else -model.loss_

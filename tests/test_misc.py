@@ -188,9 +188,9 @@ def test_gbdt_cv_timeout_returns_first_config():
     y = pd.Series((X[:, 0] % 2).astype(str))
     tmpl = GradientBoostedTreesModel(True, 2)
     # an already-expired deadline: no fold launches happen, config 0 wins
-    ci, score = gbdt_cv_grid_search(
+    ci, score, rounds = gbdt_cv_grid_search(
         X, y, True, _GBDT_GRID, 3, "balanced", tmpl, timeout_s=1e-9)
-    assert ci == 0 and score == -np.inf
+    assert ci == 0 and score == -np.inf and rounds == 0
 
 
 def test_gbdt_grid_platform_default(monkeypatch):
@@ -202,7 +202,7 @@ def test_gbdt_grid_platform_default(monkeypatch):
 
     def fake_search(X, y, is_discrete, configs, *a, **kw):
         captured["grid"] = list(configs)
-        return 0, 1.0
+        return 0, 1.0, 200
 
     monkeypatch.setattr(train, "_GBDT_GRID", train._GBDT_GRID)
     import delphi_tpu.models.gbdt as gbdt
@@ -215,7 +215,8 @@ def test_gbdt_grid_platform_default(monkeypatch):
     y = pd.Series((X[:, 0] % 2).astype(str))
 
     train._build_jax_model(X, y, True, 2, n_jobs=1, opts={})
-    assert len(captured["grid"]) == 4, "CPU default must trim to 4 configs"
+    assert len(captured["grid"]) == 2, \
+        "CPU default must trim to one config per tree depth"
 
     train._build_jax_model(
         X, y, True, 2, n_jobs=1, opts={"model.hp.max_evals": "100"})
