@@ -25,7 +25,6 @@ import pandas as pd
 
 from delphi_tpu import constraints as dc
 from delphi_tpu.ops import detect as detect_ops
-from delphi_tpu.ops.domain import compute_domain_in_error_cells
 from delphi_tpu.ops.entropy import compute_pairwise_stats, select_candidate_pairs
 from delphi_tpu.ops.freq import FreqStats, PairDistinctCounter, compute_freq_stats
 from delphi_tpu.session import get_session
@@ -283,8 +282,13 @@ class ScikitLearnBasedErrorDetector(ErrorDetector):
         if not columns:
             return self._empty_dataframe()
 
+        import jax
         run_parallel = self._table.n_rows > int(self.parallel_mode_threshold) \
-            and len(columns) > 1
+            and len(columns) > 1 \
+            and jax.process_count() == 1
+        # multi-controller SPMD requires every process to issue device
+        # computations in the same order; a thread pool would interleave
+        # them non-deterministically, so multi-host runs stay inline
         if run_parallel:
             from concurrent.futures import ThreadPoolExecutor
             workers = int(self.num_parallelism) if self.num_parallelism \
@@ -425,10 +429,25 @@ class ErrorModel:
             if not isinstance(d, ConstraintErrorDetector) and len(cells):
                 self.non_constraint_cells |= set(
                     zip(cells[ROW_IDX].astype(int), cells["attribute"]))
-        merged = pd.concat(frames, ignore_index=True) if frames \
-            else pd.DataFrame(columns=[self.row_id, "attribute", ROW_IDX])
-        return merged.drop_duplicates(subset=[self.row_id, "attribute"],
-                                      ignore_index=True)
+        if not frames:
+            return pd.DataFrame(columns=[self.row_id, "attribute", ROW_IDX])
+        if len(frames) == 1 and not isinstance(
+                detectors[0], ConstraintErrorDetector):
+            # a single non-constraint detector emits each (row, attribute)
+            # at most once (constraint detectors repeat a cell once per
+            # violated constraint, so they still need the dedup below)
+            return frames[0].reset_index(drop=True)
+        merged = pd.concat(frames, ignore_index=True)
+        # dedup on the fused (row position, attribute code) int key: hashing
+        # one int64 column is several times faster than the multi-column
+        # object dedup at north-star cell counts; keep-first order matches
+        # drop_duplicates
+        attr_codes, attr_uniques = pd.factorize(
+            merged["attribute"].to_numpy(dtype=object))
+        key = merged[ROW_IDX].to_numpy().astype(np.int64) \
+            * max(len(attr_uniques), 1) + attr_codes
+        dup = pd.Series(key).duplicated().to_numpy()
+        return merged[~dup].reset_index(drop=True)
 
     def _resolve_error_cells_input(self, table: EncodedTable) -> pd.DataFrame:
         """Maps a user-provided error-cell frame/view to the internal format
@@ -472,8 +491,11 @@ class ErrorModel:
         rows_arr = cells_df[ROW_IDX].to_numpy()
         currents = np.empty(len(cells_df), dtype=object)
         attrs_arr = cells_df["attribute"].to_numpy()
-        for attr in pd.unique(attrs_arr):
-            sel = attrs_arr == attr
+        # factorize once: per-attribute selection compares int8/int64 codes,
+        # not millions of python strings per attribute
+        attr_codes, attr_uniques = pd.factorize(attrs_arr)
+        for ai, attr in enumerate(attr_uniques):
+            sel = attr_codes == ai
             col = table.column(attr)
             codes = col.codes[rows_arr[sel].astype(np.int64)]
             vals = np.empty(len(codes), dtype=object)
@@ -546,35 +568,22 @@ class ErrorModel:
         rows_np = noisy_cells_df[ROW_IDX].to_numpy().astype(np.int64)
         attrs_np = noisy_cells_df["attribute"].to_numpy(dtype=object)
         curs_np = noisy_cells_df["current_value"].to_numpy(dtype=object)
-        domains = compute_domain_in_error_cells(
+
+        # Weak labeling: if the top domain value equals the current value, the
+        # cell is deemed clean (reference errors.py:517-525). The mask kernel
+        # stays in array land end to end — no per-cell domain lists.
+        from delphi_tpu.ops.domain import compute_weak_label_mask
+        demote = compute_weak_label_mask(
             disc, (rows_np, attrs_np, curs_np), continuous_columns,
             target_columns, freq, pairwise, domain_stats,
             self._get_option_value(*self._opt_max_attrs_to_compute_domains),
             self._get_option_value(*self._opt_domain_threshold_alpha),
             self._get_option_value(*self._opt_domain_threshold_beta))
-
-        # Weak labeling: if the top domain value equals the current value, the
-        # cell is deemed clean (reference errors.py:517-525).
-        fixed = set()
-        for d in domains:
-            if d.domain and d.current_value is not None and d.domain[0][0] == d.current_value:
-                fixed.add((d.row_index, d.attribute))
-
-        if fixed:
-            # vectorized pair membership over a fused (row, attribute) key
-            attr_codes, attr_uniques = pd.factorize(attrs_np)
-            attr_index = {a: i for i, a in enumerate(attr_uniques)}
-            key = rows_np * len(attr_uniques) + attr_codes
-            fixed_keys = np.fromiter(
-                (r * len(attr_uniques) + attr_index[a] for r, a in fixed
-                 if a in attr_index), dtype=np.int64)
-            keep = ~np.isin(key, fixed_keys)
-        else:
-            keep = np.ones(len(noisy_cells_df), dtype=bool)
-        error_cells_df = noisy_cells_df[keep].reset_index(drop=True)
-        assert len(noisy_cells_df) == len(error_cells_df) + len(fixed)
+        fixed = int(demote.sum())
+        error_cells_df = noisy_cells_df[~demote].reset_index(drop=True)
+        assert len(noisy_cells_df) == len(error_cells_df) + fixed
         _logger.info(
-            f"[Error Detection Phase] {len(fixed)} noisy cells fixed and "
+            f"[Error Detection Phase] {fixed} noisy cells fixed and "
             f"{len(error_cells_df)} error cells remaining...")
         return error_cells_df
 

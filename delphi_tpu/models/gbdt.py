@@ -361,12 +361,26 @@ def _mesh_predict_fn(mesh, n_rounds, depth, objective, k):
 
     from delphi_tpu.parallel.mesh import shard_map
 
-    def fn(bins_l, feats, thrs, leaves, base):
-        return _predict_boosted(bins_l, feats, thrs, leaves, n_rounds,
-                                depth, objective, k, base, axis_name="dp")
+    # Multi-host: row-sharded predictions span processes, so they
+    # all-gather to every device and each host reads the full vector
+    # (single-host meshes skip the collective and fetch the sharded array).
+    multihost = jax.process_count() > 1
+    row_axis = 1 if objective == "multiclass" else 0
 
-    out_spec = P(None, "dp") if objective == "multiclass" else P("dp")
-    return jax.jit(shard_map(
+    def fn(bins_l, feats, thrs, leaves, base):
+        F = _predict_boosted(bins_l, feats, thrs, leaves, n_rounds,
+                             depth, objective, k, base, axis_name="dp")
+        if multihost:
+            F = jax.lax.all_gather(F, "dp", axis=row_axis, tiled=True)
+        return F
+
+    if multihost:
+        from delphi_tpu.parallel.mesh import shard_map_unchecked as smap
+        out_spec = P()
+    else:
+        smap = shard_map
+        out_spec = P(None, "dp") if objective == "multiclass" else P("dp")
+    return jax.jit(smap(
         fn, mesh=mesh,
         in_specs=(P("dp", None), P(), P(), P(), P()),
         out_specs=out_spec))

@@ -71,52 +71,21 @@ def compute_domain_in_error_cells(
         curs_all = np.array([c for _, _, c in cells], dtype=object)
 
     out: List[CellDomain] = []
-    import pandas as pd
-    attr_codes, attr_uniques = pd.factorize(attrs_all) if len(attrs_all) \
-        else (np.zeros(0, np.int64), np.zeros(0, object))
-
-    for ai, attr in enumerate(attr_uniques):
-        if attr not in target_attrs:
-            continue
-        sel = attr_codes == ai
-        rows = rows_all[sel]
-        currents = curs_all[sel]
-
-        corr_attrs = [c for c, _ in pairwise_stats.get(attr, [])][:max_attrs_to_compute_domains]
-        corr_attrs = [c for c in corr_attrs if freq.has_pair(c, attr)]
-
-        if attr in continuous or not corr_attrs or not table.has_column(attr):
+    for group in _iter_attr_groups(
+            disc, (rows_all, attrs_all, curs_all), continuous_attrs,
+            target_attrs, freq, pairwise_stats, domain_stats,
+            max_attrs_to_compute_domains, alpha):
+        attr, rows, currents = group.attr, group.rows, group.currents
+        if group.empty_domain:
             out.extend(CellDomain(int(r), attr, cur, [])
                        for r, cur in zip(rows, currents))
             continue
-
         vocab = table.column(attr).vocab
-        single = freq.single(attr)[1:]  # [v_a], non-NULL value counts
-        has_single = single > 0
-
-        pair_tables = []
-        taus = []
-        corr_codes = []
-        for c in corr_attrs:
-            d_c = int(domain_stats[c])
-            d_a = int(domain_stats[attr])
-            taus.append(int(alpha * (n // max(d_c * d_a, 1))))
-            pair_tables.append(freq.pair(c, attr))  # [V_c + 1, V_a + 1]
-            corr_codes.append(table.column(c).codes)
-
-        # Cells process in bounded chunks: the [cells, v_a] score matrices are
-        # the phase's memory peak at north-star scale, and a fixed chunk also
-        # gives the mesh kernel a stable shard shape.
-        chunk = max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS", "1000000")))
-        for lo in range(0, len(rows), chunk):
-            sub_rows = rows[lo:lo + chunk]
-            codes_chunk = [c[sub_rows] for c in corr_codes]
-            prob, contributed = _score_cells(
-                codes_chunk, pair_tables, taus, has_single, n)
-
+        for lo, prob, contributed in group.score_chunks():
             # One nonzero + lexsort over every surviving (cell, value) entry
             # instead of a per-cell scan: Python-level work is proportional to
             # the kept domain entries (few per cell), not cells x vocabulary.
+            sub_rows = rows[lo:lo + len(prob)]
             keep_mask = contributed & (prob > beta)
             cell_idx, val_idx = np.nonzero(keep_mask)
             probs_sel = prob[cell_idx, val_idx]
@@ -132,6 +101,143 @@ def compute_domain_in_error_cells(
                 out.append(CellDomain(int(r), attr, cur, doms[i]))
 
     return out
+
+
+@dataclass
+class _AttrGroup:
+    """One target attribute's error cells plus everything domain scoring
+    needs for them — the scaffolding shared by the domain builder and the
+    weak-label mask so their tau / correlate-selection / chunking semantics
+    cannot diverge."""
+    attr: str
+    pos: np.ndarray           # positions into the caller's cell arrays
+    rows: np.ndarray
+    currents: np.ndarray
+    empty_domain: bool
+    _ctx: Optional[tuple] = None
+
+    def score_chunks(self):
+        """Yields (chunk offset, prob [cells, v_a], contributed) via the
+        (mesh-dispatching) scoring kernel, in DELPHI_DOMAIN_CHUNK_CELLS
+        chunks — the [cells, v_a] matrices are the phase's memory peak at
+        north-star scale, and a fixed chunk gives the mesh kernel a stable
+        shard shape."""
+        assert self._ctx is not None
+        pair_tables, taus, corr_codes, has_single, n = self._ctx
+        chunk = max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS",
+                                          "1000000")))
+        for lo in range(0, len(self.rows), chunk):
+            sub_rows = self.rows[lo:lo + chunk]
+            codes_chunk = [c[sub_rows] for c in corr_codes]
+            prob, contributed = _score_cells(
+                codes_chunk, pair_tables, taus, has_single, n)
+            yield lo, prob, contributed
+
+
+def _iter_attr_groups(disc: DiscretizedTable,
+                      cells: Tuple[np.ndarray, np.ndarray, np.ndarray],
+                      continuous_attrs: Sequence[str],
+                      target_attrs: Sequence[str],
+                      freq: FreqStats,
+                      pairwise_stats: Dict[str, List[Tuple[str, float]]],
+                      domain_stats: Dict[str, int],
+                      max_attrs_to_compute_domains: int,
+                      alpha: float):
+    """Per-target-attribute iteration shared by domain building and weak
+    labeling: correlate selection (top pairwise attrs with pair counts),
+    tau = int(alpha * (n // (|dom c| * |dom a|))) with the reference's
+    integer-division quirk (RepairApi.scala:572-576), and the pair-table /
+    correlate-code assembly."""
+    import pandas as pd
+
+    n = disc.table.n_rows
+    table = disc.table
+    continuous = set(continuous_attrs)
+    rows_all, attrs_all, curs_all = cells
+    attr_codes, attr_uniques = pd.factorize(attrs_all) if len(attrs_all) \
+        else (np.zeros(0, np.int64), np.zeros(0, object))
+
+    for ai, attr in enumerate(attr_uniques):
+        if attr not in target_attrs:
+            continue
+        pos = np.nonzero(attr_codes == ai)[0]
+        rows = rows_all[pos]
+        currents = curs_all[pos]
+
+        corr_attrs = [c for c, _ in
+                      pairwise_stats.get(attr, [])][:max_attrs_to_compute_domains]
+        corr_attrs = [c for c in corr_attrs if freq.has_pair(c, attr)]
+        if attr in continuous or not corr_attrs or not table.has_column(attr):
+            yield _AttrGroup(attr, pos, rows, currents, empty_domain=True)
+            continue
+
+        single = freq.single(attr)[1:]  # [v_a], non-NULL value counts
+        has_single = single > 0
+        pair_tables, taus, corr_codes = [], [], []
+        for c in corr_attrs:
+            d_c = int(domain_stats[c])
+            d_a = int(domain_stats[attr])
+            taus.append(int(alpha * (n // max(d_c * d_a, 1))))
+            pair_tables.append(freq.pair(c, attr))  # [V_c + 1, V_a + 1]
+            corr_codes.append(table.column(c).codes)
+        yield _AttrGroup(attr, pos, rows, currents, empty_domain=False,
+                         _ctx=(pair_tables, taus, corr_codes, has_single, n))
+
+
+def compute_weak_label_mask(
+        disc: DiscretizedTable,
+        cells: Tuple[np.ndarray, np.ndarray, np.ndarray],
+        continuous_attrs: Sequence[str],
+        target_attrs: Sequence[str],
+        freq: FreqStats,
+        pairwise_stats: Dict[str, List[Tuple[str, float]]],
+        domain_stats: Dict[str, int],
+        max_attrs_to_compute_domains: int,
+        alpha: float,
+        beta: float) -> np.ndarray:
+    """Weak-label demotion mask, aligned with the input cells: True where
+    the cell's TOP domain value (highest posterior, ties broken by value
+    order — the same ordering `compute_domain_in_error_cells` emits) equals
+    its current value, i.e. the cell is deemed clean (reference
+    errors.py:517-525).
+
+    This is the pipeline's only at-scale consumer of domain scoring, and it
+    needs exactly one value per cell — so it stays in array land end to
+    end: the scoring matrices come from the same (mesh-dispatching)
+    `_score_cells` kernel via the shared `_iter_attr_groups` scaffolding,
+    and the top-value pick is an argmin over vocab ranks, not a per-cell
+    Python list build (which dominated the phase at the 1e8-row north
+    star)."""
+    assert max_attrs_to_compute_domains > 0
+    table = disc.table
+    demote = np.zeros(len(cells[0]), dtype=bool)
+
+    for group in _iter_attr_groups(
+            disc, cells, continuous_attrs, target_attrs, freq,
+            pairwise_stats, domain_stats, max_attrs_to_compute_domains,
+            alpha):
+        if group.empty_domain:
+            continue  # empty domain -> never demoted
+        vocab = table.column(group.attr).vocab
+        vocab_str = np.array([str(v) for v in vocab], dtype=object)
+        # rank of each vocab slot in string sort order: the argmin below
+        # then picks the lexicographically-smallest value among prob ties,
+        # matching the (-prob, value) lexsort of the domain builder
+        order = np.argsort(vocab_str.astype(str), kind="stable")
+        vocab_rank = np.empty(len(vocab), dtype=np.int64)
+        vocab_rank[order] = np.arange(len(vocab))
+
+        for lo, prob, contributed in group.score_chunks():
+            masked = np.where(contributed & (prob > beta), prob, -np.inf)
+            best_p = masked.max(axis=1)
+            has_domain = best_p > -np.inf
+            ties = masked == best_p[:, None]
+            rank_masked = np.where(ties, vocab_rank[None, :],
+                                   np.iinfo(np.int64).max)
+            top = rank_masked.argmin(axis=1)
+            eq = vocab_str[top] == group.currents[lo:lo + len(prob)]
+            demote[group.pos[lo:lo + len(prob)]] = has_domain & eq.astype(bool)
+    return demote
 
 
 def _score_cells(codes_chunk: List[np.ndarray],

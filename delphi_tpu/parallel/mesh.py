@@ -9,8 +9,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
     from jax import shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore # noqa: F401
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking disabled, spelled portably: the
+    flag is ``check_vma`` on jax >= 0.8 and ``check_rep`` on the
+    experimental fallback. Needed when an output is made replicated by an
+    explicit ``all_gather(tiled=True)`` the checker cannot see through."""
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{_SHARD_MAP_CHECK_KW: False})
+
 
 _active_mesh_cache: dict = {}
 

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from delphi_tpu.parallel.mesh import pad_rows_to_multiple, shard_map, shard_rows
+from delphi_tpu.parallel.mesh import (
+    pad_rows_to_multiple, shard_map, shard_map_unchecked, shard_rows)
 
 
 def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarray:
@@ -96,8 +97,17 @@ def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],
     taus_arr = np.asarray([max(int(t), 0) for t in taus], dtype=np.int32)
     hs = np.asarray(has_single, dtype=bool)
 
-    @partial(shard_map, mesh=mesh, in_specs=(P("dp", None), P(), P(), P()),
-             out_specs=(P("dp", None), P("dp", None), P("dp", None)))
+    # Multi-host: a row-sharded output spans processes and cannot be read
+    # back by any single host, so the per-cell scores all-gather to every
+    # device (same transient size the single-host path materializes anyway;
+    # the chunked caller bounds `cells`).
+    multihost = jax.process_count() > 1
+    out_shard = P() if multihost else P("dp", None)
+
+    smap = shard_map_unchecked if multihost else shard_map
+
+    @partial(smap, mesh=mesh, in_specs=(P("dp", None), P(), P(), P()),
+             out_specs=(out_shard, out_shard, out_shard))
     def kernel(local, tables, taus_arr, hs):
         def one(codes_c, table_c, tau):
             gathered = table_c[codes_c + 1][:, 1:]          # [cells, v_a]
@@ -108,7 +118,11 @@ def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],
             return big, tiny, active
         bigs, tinys, actives = jax.vmap(one, in_axes=(1, 0, 0))(
             local, tables, taus_arr)
-        return (bigs.sum(axis=0), tinys.sum(axis=0), actives.any(axis=0))
+        out = (bigs.sum(axis=0), tinys.sum(axis=0), actives.any(axis=0))
+        if multihost:
+            out = tuple(jax.lax.all_gather(o, "dp", axis=0, tiled=True)
+                        for o in out)
+        return out
 
     big, tiny, contributed = kernel(
         shard_rows(padded, mesh), jnp.asarray(tables), jnp.asarray(taus_arr),

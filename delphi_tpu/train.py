@@ -263,8 +263,15 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                     # keep 4: RMSE gates (boston CRIM+RAD) are sensitive to
                     # the reg_lambda/min_child_weight axis the 2-config trim
                     # would drop, and regression targets are the minority.
-                    if is_discrete and len(grid) > 2:
-                        grid = [grid[0], grid[2]]
+                    if is_discrete:
+                        seen_depths = set()
+                        trimmed = []
+                        for cfg in grid[:4]:
+                            depth = cfg.get("max_depth", 7)
+                            if depth not in seen_depths:
+                                seen_depths.add(depth)
+                                trimmed.append(cfg)
+                        grid = trimmed
                     else:
                         grid = grid[:4]
             if is_discrete and num_class > 8:

@@ -75,6 +75,138 @@ if jax.process_index() == 0:
 """
 
 
+_E2E_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["REPO"])
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+mode = sys.argv[1]  # "single" or a distributed rank id
+if mode != "single":
+    os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
+    os.environ["DELPHI_NUM_PROCESSES"] = "2"
+    os.environ["DELPHI_PROCESS_ID"] = mode
+    os.environ["DELPHI_MESH"] = "auto"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import pandas as pd
+from delphi_tpu import (
+    ConstraintErrorDetector, NullErrorDetector, RegExErrorDetector, delphi)
+
+if mode != "single":
+    from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+    assert maybe_initialize_distributed()
+    assert jax.process_count() == 2
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+    assert mesh is not None and mesh.shape["dp"] == 2
+    # the mesh spans devices owned by DIFFERENT processes: phase-2 training
+    # histograms / logistic gradients psum across the process boundary (the
+    # DCN analog), phase-3 inference all-gathers its row shards
+    assert len({d.process_index for d in mesh.devices.flat}) == 2
+
+hospital = pd.read_csv(os.environ["HOSPITAL_CSV"], dtype=str)
+delphi.register_table("hospital", hospital)
+
+def build():
+    return delphi.repair \
+        .setTableName("hospital").setRowId("tid") \
+        .setDiscreteThreshold(400) \
+        .setErrorDetectors([
+            NullErrorDetector(),
+            ConstraintErrorDetector(os.environ["CONSTRAINTS"]),
+            RegExErrorDetector("Sample", "^[0-9]{1,3} patients$"),
+        ])
+
+det = build().run(detect_errors_only=True) \
+    .sort_values(["tid", "attribute"]).reset_index(drop=True)
+rep = build() \
+    .setTargets(["City", "State", "MeasureCode", "EmergencyService"]) \
+    .run().sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+if mode == "single" or jax.process_index() == 0:
+    out = os.environ["OUT"] + ("_single" if mode == "single" else "_mesh")
+    det.to_json(out + ".det.json", orient="split")
+    rep.to_json(out + ".rep.json", orient="split")
+print("E2E_WORKER_OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DELPHI_PERF_TESTS"),
+    reason="2-process end-to-end pipeline runs with DELPHI_PERF_TESTS only")
+def test_two_process_end_to_end_hospital(tmp_path):
+    """The FULL pipeline (detect -> train -> repair) on a 2-process cluster,
+    each process owning one CPU device of the dp mesh, asserted against a
+    single-process run: phase-1 detection must match EXACTLY (integer psum
+    reductions), phase-2/3 repairs must cover the same cells with >= 98%
+    identical values (float psum reassociation can flip near-ties) — the
+    reference runs every phase on the cluster (model.py:817-926, 1054-1135,
+    SURVEY.md P2/P3); this is the TPU build's multi-host equivalent."""
+    import pandas as pd
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "e2e_worker.py"
+    worker.write_text(_E2E_WORKER)
+    repo = str(Path(__file__).resolve().parents[1])
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DELPHI_MESH")}
+    env["COORD"] = f"127.0.0.1:{port}"
+    env["HOSPITAL_CSV"] = str(TESTDATA / "hospital.csv")
+    env["CONSTRAINTS"] = str(TESTDATA / "hospital_constraints.txt")
+    env["REPO"] = repo
+    env["OUT"] = str(tmp_path / "e2e")
+
+    # single-process reference first (its own interpreter: no distributed env)
+    single = subprocess.run(
+        [sys.executable, str(worker), "single"], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=900)
+    assert single.returncode == 0, single.stdout[-3000:]
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i)], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+
+    det_s = pd.read_json(env["OUT"] + "_single.det.json", orient="split",
+                         convert_axes=False, dtype=False)
+    det_m = pd.read_json(env["OUT"] + "_mesh.det.json", orient="split",
+                         convert_axes=False, dtype=False)
+    pd.testing.assert_frame_equal(det_m.reset_index(drop=True),
+                                  det_s.reset_index(drop=True))
+    assert len(det_s) > 0
+
+    rep_s = pd.read_json(env["OUT"] + "_single.rep.json", orient="split",
+                         convert_axes=False, dtype=False)
+    rep_m = pd.read_json(env["OUT"] + "_mesh.rep.json", orient="split",
+                         convert_axes=False, dtype=False)
+    assert len(rep_m) == len(rep_s) > 0
+    assert (rep_s[["tid", "attribute"]].reset_index(drop=True)
+            == rep_m[["tid", "attribute"]].reset_index(drop=True)).all().all()
+    agree = (rep_s["repaired"].fillna("\0").reset_index(drop=True)
+             == rep_m["repaired"].fillna("\0").reset_index(drop=True)).mean()
+    assert agree >= 0.98, f"2-process repairs diverge: {agree:.2%}"
+
+
 @pytest.mark.skipif(
     os.environ.get("DELPHI_SKIP_DIST_SMOKE") == "1",
     reason="explicitly disabled")

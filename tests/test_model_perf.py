@@ -217,3 +217,94 @@ def test_repair_perf_hospital(perf_session):
     f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
     print(f"hospital repair: precision={precision:.4f} recall={recall:.4f} f1={f1:.4f}")
     assert precision > 0.95 and recall > 0.95 and f1 > 0.95, (precision, recall, f1)
+
+
+def _make_tax_shaped(n_rows: int = 20000, error_rate: float = 0.03):
+    """Synthetic stand-in for the raha tax workload (the reference's
+    resources/examples/tax.py, F1=0.999): the checkout's testdata/raha/
+    lacks tax.csv, so this generator reproduces its SHAPE — a numeric,
+    FD-heavy personal-tax table (zip -> city/state, state -> rate,
+    marital_status/has_child -> exemption columns) with ground-truth error
+    cells over the same three targets the example repairs. Returns
+    (dirty_df, error_cells_df with correct_val)."""
+    rng = np.random.RandomState(11)
+    n_states = 30
+    zips_per_state = 10
+    states = [f"S{i:02d}" for i in range(n_states)]
+    rates = np.round(rng.uniform(1.0, 9.0, n_states), 1)
+    zip_state = rng.randint(0, n_states, n_states * zips_per_state)
+    zip_city = [f"CITY{j:03d}" for j in range(len(zip_state))]
+
+    zi = rng.randint(0, len(zip_state), n_rows)
+    si = zip_state[zi]
+    marital = rng.choice(["M", "S"], n_rows)
+    has_child = np.where(
+        (marital == "M") & (rng.rand(n_rows) < 0.6), "Y", "N")
+    salary = rng.randint(20, 200, n_rows) * 1000
+    df = pd.DataFrame({
+        "tid": np.arange(n_rows).astype(str),
+        "f_name": [f"F{i % 997}" for i in range(n_rows)],
+        "l_name": [f"L{i % 1009}" for i in range(n_rows)],
+        "gender": rng.choice(["M", "F"], n_rows),
+        "area_code": (200 + si * 7).astype(str),
+        "city": np.array(zip_city, dtype=object)[zi],
+        "state": np.array(states, dtype=object)[si],
+        "zip": (10000 + zi).astype(str),
+        "marital_status": marital,
+        "has_child": has_child,
+        "salary": salary.astype(str),
+        "rate": rates[si].astype(str),
+        "single_exemp": np.where(marital == "S", "2000", "0"),
+        "married_exemp": np.where(marital == "M", "7150", "0"),
+        "child_exemp": np.where(has_child == "Y", "1500", "0"),
+    })
+
+    targets = ["state", "marital_status", "has_child"]
+    cells = []
+    dirty = df.copy()
+    for attr in targets:
+        idx = rng.choice(n_rows, int(n_rows * error_rate), replace=False)
+        cur = dirty[attr].to_numpy().copy()
+        for i in idx:
+            if attr == "state":
+                cur[i] = states[(si[i] + 1 + rng.randint(n_states - 1))
+                                % n_states]
+            elif attr == "marital_status":
+                cur[i] = "S" if cur[i] == "M" else "M"
+            else:
+                cur[i] = "N" if cur[i] == "Y" else "Y"
+        dirty[attr] = cur
+        cells.append(pd.DataFrame({
+            "tid": idx.astype(str), "attribute": attr,
+            "correct_val": df[attr].to_numpy()[idx]}))
+    return dirty, pd.concat(cells, ignore_index=True)
+
+
+@full_perf_only
+def test_repair_perf_tax_shaped(perf_session):
+    """Tax-workload shape gate (reference tax.py transcript: P/R/F1 = 0.999
+    with ground-truth error cells over state/marital_status/has_child).
+    The FD structure (zip -> state, exemption columns -> marital/child
+    status) makes the three targets near-perfectly recoverable; anything
+    below 0.95 means the FD/stat model path regressed on numeric-heavy,
+    rule-structured tables."""
+    dirty, error_cells = _make_tax_shaped()
+    s = perf_session
+    s.register("tax_shaped", dirty)
+    s.register("tax_shaped_error_cells", error_cells[["tid", "attribute"]])
+
+    repaired = delphi.repair.setInput("tax_shaped").setRowId("tid") \
+        .setErrorCells("tax_shaped_error_cells") \
+        .setTargets(["state", "marital_status", "has_child"]) \
+        .setDiscreteThreshold(300) \
+        .run()
+
+    rep = repaired.astype({"tid": str})
+    pdf = rep.merge(error_cells, on=["tid", "attribute"], how="inner")
+    rdf = error_cells.merge(rep, on=["tid", "attribute"], how="left")
+    precision = float((pdf["repaired"] == pdf["correct_val"]).mean())
+    recall = float((rdf["repaired"] == rdf["correct_val"]).mean())
+    f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+    print(f"tax-shaped repair: precision={precision:.4f} recall={recall:.4f} "
+          f"f1={f1:.4f}")
+    assert precision > 0.95 and recall > 0.95 and f1 > 0.95, (precision, recall, f1)
