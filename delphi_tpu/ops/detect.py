@@ -18,6 +18,7 @@ All detectors return row-index arrays per attribute; the Python wrappers in
 """
 
 import re
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -90,7 +91,18 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
             # (choice(replace=False) would permute the whole column)
             rng = np.random.RandomState(42)
             pool = pool[rng.randint(0, len(pool), APPROX_PERCENTILE_SAMPLE)]
-        q1, q3 = np.percentile(pool, [25.0, 75.0])
+        if _use_device_detect(len(pool)):
+            # exact percentiles as one device sort — the full-column scan
+            # stays off the host on TPU (ErrorDetectorApi.scala:249-300 runs
+            # it as a distributed percentile job); x64 keeps the fences
+            # bit-compatible with the host np.percentile
+            import jax.numpy as jnp
+            from jax import enable_x64
+            with enable_x64():
+                q1, q3 = np.asarray(jnp.percentile(
+                    jnp.asarray(pool), jnp.asarray([25.0, 75.0])))
+        else:
+            q1, q3 = np.percentile(pool, [25.0, 75.0])
         lower = q1 - 1.5 * (q3 - q1)
         upper = q3 + 1.5 * (q3 - q1)
         _logger.info(f"Non-outlier values in {attr} should be in [{lower}, {upper}]")
@@ -186,6 +198,110 @@ def _one_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     return mask
 
 
+def _use_device_detect(n: int) -> bool:
+    """Routes the single-EQ constraint kernels (and large percentile scans)
+    onto the accelerator: on TPU the sort/searchsorted programs keep the
+    violation scan off the host entirely (reference: every detector is a
+    distributed Spark job, ErrorDetectorApi.scala:128-300); the CPU backend
+    keeps the numpy path, whose factorize/bincount beats XLA:CPU sorts.
+    DELPHI_DEVICE_DETECT=1/0 forces the choice (tests use 1 to prove
+    device/host equivalence on the CPU backend)."""
+    import os
+    setting = os.environ.get("DELPHI_DEVICE_DETECT", "auto")
+    if setting == "1":
+        return True
+    if setting == "0":
+        return False
+    import jax
+    return n >= 4096 and jax.default_backend() != "cpu"
+
+
+def _pad_pow2(arr, fill):
+    n = len(arr)
+    target = max(8, 1 << (max(n, 1) - 1).bit_length())
+    if target == n:
+        return arr
+    return np.concatenate([arr, np.full(target - n, fill, arr.dtype)])
+
+
+def _jit_sorted_count():
+    # module-level jitted kernels: a fresh jit wrapper per call would retrace
+    # and recompile on every constraint evaluation
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(k2, k1):
+        s = jnp.sort(k2)
+        return jnp.searchsorted(s, k1, side="right") \
+            - jnp.searchsorted(s, k1, side="left")
+
+    return kernel
+
+
+def _jit_group_extrema():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("n_groups", "want_max"))
+    def kernel(v, g, n_groups, want_max):
+        init = -jnp.inf if want_max else jnp.inf
+        safe = jnp.where(jnp.isnan(v), init, v)
+        if want_max:
+            return jax.ops.segment_max(safe, g, num_segments=n_groups)
+        return jax.ops.segment_min(safe, g, num_segments=n_groups)
+
+    return kernel
+
+
+_sorted_count_kernel = None
+_group_extrema_kernel = None
+
+
+def _device_sorted_count(keys2: np.ndarray, keys1: np.ndarray) -> np.ndarray:
+    """#right-side rows whose key equals each left row's key, as one jitted
+    sort + two searchsorted passes — O(n log n) on device with O(n) memory,
+    no dense (group x value) histogram to size. Runs under enable_x64: the
+    fused (group, value) keys are true int64 — default canonicalization
+    would truncate them to int32 and collide groups at scale."""
+    global _sorted_count_kernel
+    import jax.numpy as jnp
+    from jax import enable_x64
+
+    if _sorted_count_kernel is None:
+        _sorted_count_kernel = _jit_sorted_count()
+    n = len(keys1)
+    big = np.iinfo(np.int64).max
+    with enable_x64():
+        out = _sorted_count_kernel(
+            jnp.asarray(_pad_pow2(keys2.astype(np.int64), big)),
+            jnp.asarray(_pad_pow2(keys1.astype(np.int64), big - 1)))
+        out = np.asarray(out)
+    return out[:n]
+
+
+def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
+                          n_groups: int, want_max: bool) -> np.ndarray:
+    """Per-group max/min of ``values`` (NaN entries excluded) as a jitted
+    segment reduction; groups is int64[n] in [0, n_groups). Runs under
+    enable_x64 so float64 comparison values keep their full mantissa (a
+    float32 downcast would round group extrema and flip LT/GT verdicts vs
+    the host path)."""
+    global _group_extrema_kernel
+    import jax.numpy as jnp
+    from jax import enable_x64
+
+    if _group_extrema_kernel is None:
+        _group_extrema_kernel = _jit_group_extrema()
+    # padding rows route to an extra scratch segment
+    v = _pad_pow2(values.astype(np.float64), np.nan)
+    g = _pad_pow2(groups.astype(np.int64), n_groups)
+    with enable_x64():
+        out = np.asarray(_group_extrema_kernel(
+            jnp.asarray(v), jnp.asarray(g), n_groups + 1, want_max))
+    return out[:n_groups]
+
+
 def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         -> np.ndarray:
     """Left-tuple rows r1 with some r2 satisfying the conjunction.
@@ -232,8 +348,12 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         g1 = g2 = np.zeros(n, dtype=np.int64)
         n_groups = 1 if n else 0
 
+    device = _use_device_detect(n)
+
     if not rest:
         # Violation iff the right-side group is non-empty (self matches).
+        if device:
+            return _device_sorted_count(g2, g1) > 0
         group_count = np.bincount(g2, minlength=n_groups)
         return group_count[g1] > 0
 
@@ -242,6 +362,19 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
         if p.sign == "IQ":
             a1, a2 = _shared_codes(table, p.left.name, p.right.name)
+            if device:
+                # r1 violates iff some group member carries a right-value
+                # different from r1's left-value: #group - #matching > 0.
+                # Two sorted-count scans — same null-safe semantics as the
+                # distinct-count formulation below (NULL participates as an
+                # ordinary key value). The stride covers BOTH columns'
+                # codes: a left-only shared-dictionary value with
+                # a1 > a2.max() must not alias into the next group's keys.
+                stride = int(max(a1.max(initial=-1), a2.max(initial=-1))) + 2
+                f2 = g2.astype(np.int64) * stride + (a2 + 1)
+                f1 = g1.astype(np.int64) * stride + (a1 + 1)
+                return (_device_sorted_count(g2, g1)
+                        - _device_sorted_count(f2, f1)) > 0
             # r1 violates iff its group holds a right-value different from
             # r1's left-value (null-safe inequality counts NULL vs value).
             # Fused 1-D key instead of np.unique(axis=0) over a 2D stack.
@@ -259,13 +392,17 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
             v2 = _comparable_values(table, p.right.name)
             # r1 violates iff r1.left < max(group right) (LT) / > min (GT);
             # NULLs never satisfy an order comparison.
-            valid2 = ~np.isnan(v2)
-            init = -np.inf if p.sign == "LT" else np.inf
-            ext = np.full(n_groups, init)
-            if p.sign == "LT":
-                np.maximum.at(ext, g2[valid2], v2[valid2])
+            if device:
+                ext = _device_group_extrema(v2, g2, n_groups,
+                                            want_max=(p.sign == "LT"))
             else:
-                np.minimum.at(ext, g2[valid2], v2[valid2])
+                valid2 = ~np.isnan(v2)
+                init = -np.inf if p.sign == "LT" else np.inf
+                ext = np.full(n_groups, init)
+                if p.sign == "LT":
+                    np.maximum.at(ext, g2[valid2], v2[valid2])
+                else:
+                    np.minimum.at(ext, g2[valid2], v2[valid2])
             bound = ext[g1]
             with np.errstate(invalid="ignore"):
                 cmp = v1 < bound if p.sign == "LT" else v1 > bound

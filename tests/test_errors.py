@@ -438,3 +438,55 @@ def test_one_tuple_lt_gt_constant_vocab_broadcast():
     gt = _one_tuple_violations(
         t, [Predicate("GT", AttrRef("n"), Constant("2.5"))])
     assert gt.tolist() == [False, True, True, False, False]
+
+
+def test_device_constraint_kernels_match_host(monkeypatch):
+    """The device (sort/searchsorted + segment-extrema) single-EQ constraint
+    kernels must flag exactly the rows the host factorize/bincount path
+    flags — DELPHI_DEVICE_DETECT forces each side on the CPU backend."""
+    import numpy as np
+    import pandas as pd
+
+    from delphi_tpu.constraints import parse_and_verify_constraints
+    from delphi_tpu.ops.detect import detect_constraint_violations
+    from delphi_tpu.table import encode_table
+
+    rng = np.random.RandomState(3)
+    n = 500
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "zip": rng.randint(0, 40, n).astype(str),
+        "city": rng.randint(0, 30, n).astype(str),
+        "state": rng.randint(0, 8, n).astype(str),
+        "salary": rng.randint(10, 99, n).astype(str),
+        "rate": rng.randint(1, 50, n).astype(str),
+    })
+    # sprinkle NULLs so null-safe semantics are exercised
+    for c in ("city", "state", "salary"):
+        df.loc[rng.choice(n, 25, replace=False), c] = None
+    table = encode_table(df, "tid")
+
+    constraints = parse_and_verify_constraints([
+        # EQ keys only, no residual (pure key-match)
+        "t1&t2&EQ(t1.zip,t2.zip)&EQ(t1.state,t2.state)",
+        # FD-style: EQ key + IQ residual
+        "t1&t2&EQ(t1.zip,t2.zip)&IQ(t1.city,t2.city)",
+        # cross-attribute IQ: the shared dictionary gives the left column
+        # codes the right column never uses (stride-aliasing regression)
+        "t1&t2&EQ(t1.zip,t2.zip)&IQ(t1.city,t2.state)",
+        # EQ key + order residual on a numeric column
+        "t1&t2&EQ(t1.state,t2.state)&LT(t1.salary,t2.salary)",
+        "t1&t2&EQ(t1.state,t2.state)&GT(t1.rate,t2.rate)",
+    ], "test_table", df.columns.tolist())
+    assert len(constraints.predicates) == 5
+
+    def run(flag):
+        monkeypatch.setenv("DELPHI_DEVICE_DETECT", flag)
+        out = detect_constraint_violations(
+            table, constraints, df.columns.tolist())
+        return {(a, tuple(rows.tolist())) for rows, a in out}
+
+    host = run("0")
+    device = run("1")
+    assert host == device
+    assert len(host) > 0
