@@ -293,12 +293,15 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
 
     if _group_extrema_kernel is None:
         _group_extrema_kernel = _jit_group_extrema()
-    # padding rows route to an extra scratch segment
+    # padding rows route to an extra scratch segment; the segment count is a
+    # STATIC jit arg, so it rounds to the next power of two (variants stay
+    # log2-bounded like the row padding) and the result slices back down
     v = _pad_pow2(values.astype(np.float64), np.nan)
     g = _pad_pow2(groups.astype(np.int64), n_groups)
+    seg_pad = max(8, 1 << (max(n_groups + 1, 1) - 1).bit_length())
     with enable_x64():
         out = np.asarray(_group_extrema_kernel(
-            jnp.asarray(v), jnp.asarray(g), n_groups + 1, want_max))
+            jnp.asarray(v), jnp.asarray(g), seg_pad, want_max))
     return out[:n_groups]
 
 

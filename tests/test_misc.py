@@ -222,3 +222,60 @@ def test_gbdt_grid_platform_default(monkeypatch):
         X, y, True, 2, n_jobs=1, opts={"model.hp.max_evals": "100"})
     assert len(captured["grid"]) == len(train._GBDT_GRID), \
         "explicit max_evals opens the full grid"
+
+
+def test_boost_chunk_resume_equals_single_scan():
+    """Chunked boosting with the margin carry must produce EXACTLY the trees
+    and margins of one uninterrupted scan — the invariant that lets the
+    early-stopping driver train any round count through one compiled chunk
+    program."""
+    import jax.numpy as jnp
+    import numpy as np
+    from delphi_tpu.models.gbdt import _boost, _init_margin
+
+    rng = np.random.RandomState(0)
+    n, d, B, depth = 64, 4, 8, 3
+    bins = jnp.asarray(rng.randint(0, B, (n, d)), dtype=jnp.int32)
+    y = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
+    w = jnp.ones(n, jnp.float32)
+    F0 = jnp.asarray(_init_margin(np.zeros(1, np.float32), n, "binary", 1))
+
+    args = (depth, B, 1 << depth, "binary", 1, 0.1, 1.0, 0.0, 1.0)
+    F_one, trees_one = _boost(bins, y, w, F0, 20, *args)
+
+    F, parts = F0, []
+    for chunk in (8, 8, 4):
+        F, t = _boost(bins, y, w, F, chunk, *args)
+        parts.append(t)
+    np.testing.assert_array_equal(np.asarray(F_one), np.asarray(F))
+    for i in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(trees_one[i]),
+            np.concatenate([np.asarray(p[i]) for p in parts], axis=0))
+
+
+def test_cv_grid_search_returns_early_stopped_rounds():
+    """The chunked CV search reports the SMALLEST checkpoint at the winning
+    config's best score, and the final-fit consumer trains exactly that many
+    rounds (tree tensors sized accordingly)."""
+    import numpy as np
+    import pandas as pd
+    from delphi_tpu.models.gbdt import (
+        _CHUNK_ROUNDS, GradientBoostedTreesModel, gbdt_cv_grid_search)
+
+    rng = np.random.RandomState(1)
+    X = rng.randint(0, 6, (600, 4)).astype(np.float64)
+    y = pd.Series((X[:, 0] % 2).astype(str))  # trivially learnable
+    tmpl = GradientBoostedTreesModel(True, 2)
+    ci, score, rounds = gbdt_cv_grid_search(
+        X, y, True, [dict(max_depth=3, learning_rate=0.3, n_estimators=200)],
+        3, "balanced", tmpl)
+    assert rounds > 0 and rounds % _CHUNK_ROUNDS == 0
+    assert rounds < 200, "perfectly learnable target must early-stop"
+    assert score > 0.99
+
+    m = GradientBoostedTreesModel(True, 2, max_depth=3, learning_rate=0.3,
+                                  n_estimators=rounds)
+    m.fit(X, y)
+    assert m._trees[0].shape[0] == rounds
+    assert (m.predict(X) == np.asarray(y)).all()
