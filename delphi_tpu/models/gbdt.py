@@ -213,6 +213,11 @@ def _predict_tree(bins, feat, thr, leaf, depth):
 # (train.py:193-200) because its trees are built by interpreted C++.
 _CHUNK_ROUNDS = 25
 
+# CV macro-F1 past which further search cannot pay for itself: repair picks
+# argmax cells, so a config above this is essentially solved and both the
+# within-group chunk loop and the cross-group loop stop here.
+_GOOD_ENOUGH_F1 = 0.995
+
 
 def _round_chunks(n_rounds: int) -> List[int]:
     q, r = divmod(max(int(n_rounds), 1), _CHUNK_ROUNDS)
@@ -749,10 +754,20 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                     else:
                         fold_scores.append(-float(s[0] / max(s[1], 1.0)))
                 mean = float(np.mean(fold_scores))
-                prev = best_by_cfg.get(ci, (-np.inf, 0))[0]
-                if mean > prev + eps:
+                if is_discrete:
+                    # classifiers rank by their best checkpoint, and the
+                    # recorded round count sizes the final fit
+                    if mean > best_by_cfg.get(ci, (-np.inf, 0))[0] + eps:
+                        best_by_cfg[ci] = (mean, rounds_done)
+                        improved = True
+                else:
+                    # regressors rank by the LATEST horizon: their final
+                    # fit trains the full round budget, so selection must
+                    # score the behavior that will actually deploy (MSE
+                    # keeps creeping down with rounds; a lucky early
+                    # checkpoint must not pick the config). Patience below
+                    # is classifier-only, so no improvement flag needed.
                     best_by_cfg[ci] = (mean, rounds_done)
-                    improved = True
                 # Early exit on a PERFECT classifier score: a config at
                 # macro-F1 1.0 on every fold cannot be beaten — remaining
                 # chunks AND groups are pure cost (on easy targets like
@@ -761,19 +776,27 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                     stop_all = True
             if stop_all:
                 break
+            # Good-enough stop WITHIN the group too: further chunks are
+            # cost in both the search and the final fit they size.
+            if is_discrete and any(
+                    best_by_cfg.get(ci, (-np.inf, 0))[0] >= _GOOD_ENOUGH_F1
+                    for ci in cfg_indices):
+                break
             if improved:
                 no_improve = 0
-            else:
+            elif is_discrete:
+                # patience applies to classifiers only: their final fit
+                # trains the best checkpoint's rounds, so stopping early is
+                # consistent. Regressors deploy at the full round budget and
+                # rank by the latest horizon, so their search must reach it.
                 no_improve += 1
                 if no_improve >= patience_chunks:
                     break
 
-        # Good-enough group stop: once some config's CV macro-F1 clears
-        # 0.995, later shape groups can improve the mean by at most 0.005 —
-        # not worth their launches (repair picks argmax cells; such targets
-        # are already essentially solved).
+        # Good-enough group stop: later shape groups' launches cannot pay
+        # for themselves either.
         if is_discrete and best_by_cfg and \
-                max(s for s, _ in best_by_cfg.values()) >= 0.995:
+                max(s for s, _ in best_by_cfg.values()) >= _GOOD_ENOUGH_F1:
             break
 
     if not best_by_cfg:
