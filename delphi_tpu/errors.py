@@ -390,14 +390,32 @@ class ErrorModel:
         # Populated during detect() for downstream phases
         self.discretized: Optional[DiscretizedTable] = None
         self.freq_stats: Optional[FreqStats] = None
-        # Cells flagged by NON-constraint detectors during phase 1, as
-        # (row_idx, attribute) pairs — captured so the one-tuple DC repair
-        # minimization can protect them without re-running detection (the
-        # dominant phase at scale). None until detectors actually run.
-        self.non_constraint_cells: Optional[set] = None
+        # Per-detector cell frames of NON-constraint detectors, captured in
+        # phase 1 so the one-tuple DC repair minimization can protect those
+        # cells without re-running detection. The (row_idx, attribute) SET
+        # view materializes lazily via `non_constraint_cells` — building it
+        # eagerly costs a Python tuple per cell, which at the 1e8-row north
+        # star added minutes to a phase that otherwise never needs it.
+        self._non_constraint_frames: Optional[List[pd.DataFrame]] = None
+        self._non_constraint_cells_cache: Optional[set] = None
 
     def _get_option_value(self, *args) -> Any:  # type: ignore
         return get_option_value(self.opts, *args)
+
+    @property
+    def non_constraint_cells(self) -> Optional[set]:
+        """(row_idx, attribute) pairs flagged by non-constraint detectors in
+        phase 1, or None if detectors never ran. Materialized on first
+        access (one Python tuple per cell — fine for the constraint-bearing
+        workloads that consult it, avoided everywhere else)."""
+        if self._non_constraint_frames is None:
+            return None
+        if self._non_constraint_cells_cache is None:
+            cells: set = set()
+            for f in self._non_constraint_frames:
+                cells |= set(zip(f[ROW_IDX].astype(int), f["attribute"]))
+            self._non_constraint_cells_cache = cells
+        return self._non_constraint_cells_cache
 
     def _get_default_error_detectors(self, table: EncodedTable) -> List[ErrorDetector]:
         detectors: List[ErrorDetector] = [NullErrorDetector()]
@@ -420,15 +438,23 @@ class ErrorModel:
         target_attrs = self._target_attrs([self.row_id] + table.column_names)
 
         frames = []
-        self.non_constraint_cells = set()
+        # The capture only ever feeds one-tuple DC repair minimization, so
+        # it is retained ONLY when a constraint detector is present —
+        # otherwise it would pin a second copy of every cell frame through
+        # phases 2-3 (gigabytes at the 1e8-row north star).
+        keep_capture = any(isinstance(d, ConstraintErrorDetector)
+                           for d in detectors)
+        self._non_constraint_frames = [] if keep_capture else None
+        self._non_constraint_cells_cache = None
         for d in detectors:
             d.setUp(self.row_id, input_name, continuous_columns, target_attrs,
                     encoded_table=table)
             cells = d.detect()
             frames.append(cells)
-            if not isinstance(d, ConstraintErrorDetector) and len(cells):
-                self.non_constraint_cells |= set(
-                    zip(cells[ROW_IDX].astype(int), cells["attribute"]))
+            if keep_capture and len(cells) \
+                    and not isinstance(d, ConstraintErrorDetector):
+                assert self._non_constraint_frames is not None
+                self._non_constraint_frames.append(cells)
         if not frames:
             return pd.DataFrame(columns=[self.row_id, "attribute", ROW_IDX])
         if len(frames) == 1 and not isinstance(
@@ -483,17 +509,20 @@ class ErrorModel:
         return df
 
     def _with_current_values(self, table: EncodedTable, cells_df: pd.DataFrame,
-                             target_attrs: List[str]) -> pd.DataFrame:
+                             factorized=None) -> pd.DataFrame:
         """Adds the `current_value` column (CAST-to-string of the original
         cell), mirroring `RepairApi.withCurrentValues` (RepairApi.scala:69-104).
         Decodes per attribute group — one vocab gather per attribute instead
         of a Python value_string call per cell."""
         rows_arr = cells_df[ROW_IDX].to_numpy()
         currents = np.empty(len(cells_df), dtype=object)
-        attrs_arr = cells_df["attribute"].to_numpy()
         # factorize once: per-attribute selection compares int8/int64 codes,
-        # not millions of python strings per attribute
-        attr_codes, attr_uniques = pd.factorize(attrs_arr)
+        # not millions of python strings per attribute (callers that already
+        # factorized the attribute column pass it through)
+        if factorized is None:
+            factorized = pd.factorize(
+                cells_df["attribute"].to_numpy(dtype=object))
+        attr_codes, attr_uniques = factorized
         for ai, attr in enumerate(attr_uniques):
             sel = attr_codes == ai
             col = table.column(attr)
@@ -519,8 +548,14 @@ class ErrorModel:
 
         noisy_columns: List[str] = []
         if len(noisy_cells_df) > 0:
-            noisy_columns = list(noisy_cells_df["attribute"].unique())
-            noisy_cells_df = self._with_current_values(table, noisy_cells_df, noisy_columns)
+            # one factorize pass serves both the column list and the
+            # per-attribute decode (a separate .unique() would re-hash every
+            # object cell)
+            factorized = pd.factorize(
+                noisy_cells_df["attribute"].to_numpy(dtype=object))
+            noisy_columns = list(factorized[1])
+            noisy_cells_df = self._with_current_values(
+                table, noisy_cells_df, factorized=factorized)
         return noisy_cells_df, noisy_columns
 
     def _compute_attr_stats(self, disc: DiscretizedTable, target_columns: List[str],

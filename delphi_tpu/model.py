@@ -462,9 +462,12 @@ class RepairModel:
             error_cells=self._error_cells_frame,
             opts=self.opts)
         result = error_model.detect(table, input_name, continuous_columns)
-        # keep phase 1's per-detector capture so the one-tuple DC repair
-        # minimization never re-runs detection (the dominant cost at scale)
-        self._phase1_non_constraint_cells = error_model.non_constraint_cells
+        # keep ONLY phase 1's per-detector cell frames (stashing the whole
+        # ErrorModel would pin its discretized table + freq stats through
+        # phases 2-3) so the one-tuple DC repair minimization never re-runs
+        # detection; the set view materializes lazily from the frames —
+        # they are None unless a constraint detector ran
+        self._phase1_non_constraint_frames = error_model._non_constraint_frames
         return result
 
     # -- phase 2 helpers: rule-based repairs ----------------------------------
@@ -971,14 +974,18 @@ class RepairModel:
         if not one_tuple:
             return None
 
-        protected = getattr(self, "_phase1_non_constraint_cells", None)
-        if protected is None:
+        frames = getattr(self, "_phase1_non_constraint_frames", None)
+        if frames is None:
             # detectors never ran (defensive: this path requires
-            # error_cells None, so phase 1 must have populated the capture)
+            # error_cells None and a constraint detector, so phase 1 must
+            # have populated the capture)
             _logger.warning(
                 "Skipping one-tuple DC minimization (phase-1 detector "
                 "capture unavailable)")
             return None
+        protected: set = set()
+        for f in frames:
+            protected |= set(zip(f[ROW_IDX].astype(int), f["attribute"]))
 
         flagged: Dict[int, Dict[str, Any]] = {}
         for r, a, cur in zip(error_cells_df[ROW_IDX].astype(int),
