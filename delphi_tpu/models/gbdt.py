@@ -81,20 +81,27 @@ class _Binner:
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "n_nodes", "axis_name",
-                                   "use_scatter"))
+                                   "use_scatter", "use_counts",
+                                   "hess_is_weight"))
 def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
                 reg_lambda, min_split_gain, min_child_weight,
                 min_child_samples, axis_name=None, bin1h2d=None,
-                use_scatter=None):
+                use_scatter=None, use_counts=True, hess_is_weight=False):
     """Grows one depth-wise tree. Returns (feat[int32 n_nodes-1],
     thr[int32 n_nodes-1], leaf[f32 n_nodes]) with all-left sentinel splits
     (thr = n_bins) for terminated nodes. Rows with weight 0 (padding /
     held-out CV rows) are excluded from the row count: ``min_child_samples``
     bounds the UNWEIGHTED participating rows per child (LightGBM's
-    min_child_samples, default 20) so heavily-upweighted rare classes cannot
-    carve single-row leaves."""
+    min_child_samples) so heavily-upweighted rare classes cannot carve
+    single-row leaves.
+
+    The histogram channel set is STATIC: the counts channel exists only
+    when ``min_child_samples`` is actually in play (``use_counts``), and
+    for the L2 objective hessian == weight (``hess_is_weight``) so the
+    weight channel is dropped — per level that's 2 channels instead of 4
+    for regression and 3 for default classification, directly scaling the
+    histogram contraction (MXU rows on TPU, segment adds on CPU)."""
     n, d = bins.shape
-    counts = (weight > 0).astype(jnp.float32)
 
     feat = jnp.zeros(n_nodes - 1, dtype=jnp.int32)
     thr = jnp.full(n_nodes - 1, n_bins, dtype=jnp.int32)
@@ -104,7 +111,7 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
     # serialize on the VPU (measured ~100x slower here and able to crash the
     # worker in large vmapped batches), while hist[l,f,b] =
     # sum_n node1h[n,l] * val[n] * bin1h[n,f,b] is exactly an
-    # (4*n_level, n) @ (n, d*B) contraction the MXU eats. bin1h is
+    # (C*n_level, n) @ (n, d*B) contraction the MXU eats. bin1h is
     # loop-invariant — callers that build many trees (the boosting scan's
     # class-tree vmap) pass it in so it materializes once, not per tree.
     # CPU: segment-sum scatter-adds — O(n*d) work instead of the matmul's
@@ -115,51 +122,61 @@ def _build_tree(bins, grad, hess, weight, depth, n_bins, n_nodes,
     if bin1h2d is None and not use_scatter:
         bin1h2d = jax.nn.one_hot(bins, n_bins,
                                  dtype=jnp.float32).reshape(n, d * n_bins)
-    vals = jnp.stack([grad, hess, weight, counts])  # (4, n)
+    channels = [grad, hess]
+    w_slot = 1 if hess_is_weight else len(channels)
+    if not hess_is_weight:
+        channels.append(weight)
+    c_slot = len(channels) if use_counts else -1
+    if use_counts:
+        channels.append((weight > 0).astype(jnp.float32))
+    vals = jnp.stack(channels)  # (C, n)
+    C = len(channels)
 
     for level in range(depth):
         n_level = 1 << level
         if use_scatter:
             seg = (node[:, None] * d + jnp.arange(d)[None, :]) * n_bins + bins
-            data = jnp.broadcast_to(vals[:, :, None], (4, n, d))
+            data = jnp.broadcast_to(vals[:, :, None], (C, n, d))
             hist = jax.vmap(lambda v: jax.ops.segment_sum(
                 v.reshape(-1), seg.reshape(-1),
                 num_segments=n_level * d * n_bins))(
-                data.reshape(4, n * d)).reshape(4, n_level, d, n_bins)
+                data.reshape(C, n * d)).reshape(C, n_level, d, n_bins)
         else:
             node1h = jax.nn.one_hot(node, n_level, dtype=jnp.float32)  # (n, l)
-            weighted = vals[:, :, None] * node1h[None]  # (4, n, n_level)
-            lhs = weighted.transpose(0, 2, 1).reshape(4 * n_level, n)
+            weighted = vals[:, :, None] * node1h[None]  # (C, n, n_level)
+            lhs = weighted.transpose(0, 2, 1).reshape(C * n_level, n)
             # HIGHEST precision: the TPU's default matmul mode rounds f32
             # operands to bf16, which perturbs split gains enough to flip
             # near-tie argmaxes vs the exact-sum semantics
             hist = jax.lax.dot_general(
                 lhs, bin1h2d, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)  # (4*n_level, d*B)
-            hist = hist.reshape(4, n_level, d, n_bins)
+                precision=jax.lax.Precision.HIGHEST)  # (C*n_level, d*B)
+            hist = hist.reshape(C, n_level, d, n_bins)
 
         if axis_name is not None:
             # rows are sharded over the mesh: local histograms reduce over
             # ICI — the TPU form of the reference's Spark shuffle (P1/P2)
             hist = jax.lax.psum(hist, axis_name)
-        hg, hh, hw, hc = hist[0], hist[1], hist[2], hist[3]
+        hg, hh, hw = hist[0], hist[1], hist[w_slot]
 
         GL = jnp.cumsum(hg, axis=2)
         HL = jnp.cumsum(hh, axis=2)
         WL = jnp.cumsum(hw, axis=2)
-        CL = jnp.cumsum(hc, axis=2)
         G = GL[:, :, -1:]
         H = HL[:, :, -1:]
         W = WL[:, :, -1:]
-        C = CL[:, :, -1:]
-        GR, HR, WR, CR = G - GL, H - HL, W - WL, C - CL
+        GR, HR, WR = G - GL, H - HL, W - WL
 
         gain = (GL * GL / (HL + reg_lambda)
                 + GR * GR / (HR + reg_lambda)
                 - G * G / (H + reg_lambda))
-        ok = (WL >= min_child_weight) & (WR >= min_child_weight) \
-            & (CL >= min_child_samples) & (CR >= min_child_samples)
+        ok = (WL >= min_child_weight) & (WR >= min_child_weight)
+        if use_counts:
+            CL = jnp.cumsum(hist[c_slot], axis=2)
+            Ct = CL[:, :, -1:]
+            CR = Ct - CL
+            ok = ok & (CL >= min_child_samples) & (CR >= min_child_samples)
         gain = jnp.where(ok, gain, -jnp.inf)
         # never split on the last bin (right side empty by construction)
         gain = gain.at[:, :, -1].set(-jnp.inf)
@@ -226,10 +243,11 @@ def _round_chunks(n_rounds: int) -> List[int]:
 
 @partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
                                    "objective", "k", "axis_name",
-                                   "collect_trees"))
+                                   "collect_trees", "use_counts"))
 def _boost(bins, y, weight, F0, n_rounds, depth, n_bins, n_nodes, objective,
            k, lr, reg_lambda, min_split_gain, min_child_weight,
-           min_child_samples=20.0, axis_name=None, collect_trees=True):
+           min_child_samples=20.0, axis_name=None, collect_trees=True,
+           use_counts=True):
     """Runs ``n_rounds`` boosting rounds as one lax.scan, RESUMING from the
     margin state ``F0`` (rows-first: [n], or [n, k] for multiclass — the
     layout row sharding understands). Returns (F, stacked trees), F
@@ -265,7 +283,9 @@ def _boost(bins, y, weight, F0, n_rounds, depth, n_bins, n_nodes, objective,
             return _build_tree(bins, gk, hk, weight, depth, n_bins, n_nodes,
                                reg_lambda, min_split_gain, min_child_weight,
                                min_child_samples, axis_name, bin1h2d,
-                               use_scatter=use_scatter)
+                               use_scatter=use_scatter,
+                               use_counts=use_counts,
+                               hess_is_weight=(objective == "regression"))
 
         feat, thr, leaf, node = jax.vmap(build)(g, h)  # [k_trees, ...]
         leaf = leaf * lr
@@ -351,7 +371,8 @@ def _mesh_boost_fn(mesh, n_rounds, depth, n_bins, n_nodes, objective, k,
     def fn(bins_l, y_l, w_l, F0_l):
         return _boost(bins_l, y_l, w_l, F0_l, n_rounds, depth, n_bins,
                       n_nodes, objective, k, lr, reg_lambda, min_split_gain,
-                      min_child_weight, min_child_samples, axis_name="dp")
+                      min_child_weight, min_child_samples, axis_name="dp",
+                      use_counts=min_child_samples > 0)
 
     F_spec = P("dp", None) if objective == "multiclass" else P("dp")
     return jax.jit(shard_map(
@@ -455,7 +476,7 @@ def _cv_chunk_fn(mesh, chunk, depth, n_bins, n_nodes, objective, k):
             F2 = _boost(bins, y_, weight, F1, chunk, depth, n_bins, n_nodes,
                         objective, k, lr, reg_lambda, min_split_gain,
                         min_child_weight, 0.0, axis_name=axis_name,
-                        collect_trees=False)
+                        collect_trees=False, use_counts=False)
             stats = _cv_stats(F2, y_, val_mask, y_cmp, log_flag, cw_corr,
                               class_valid, objective, kk, axis_name)
             return F2, stats
@@ -981,7 +1002,8 @@ class GradientBoostedTreesModel:
                     bins_dev, y_dev, w_dev, F, chunk, self.max_depth,
                     self._n_bins, self._n_nodes, self._objective,
                     max(self._k, 1), self.learning_rate, self.reg_lambda,
-                    self.min_split_gain, self.min_child_weight, mcs)
+                    self.min_split_gain, self.min_child_weight, mcs,
+                    use_counts=mcs > 0)
                 parts.append(trees)
         parts = [jax.device_get(t) for t in parts]
         self._trees = tuple(

@@ -207,3 +207,57 @@ def test_pair_distinct_counter_chunked_warm(monkeypatch):
     warmed = freq_mod.PairDistinctCounter(table)
     warmed.warm(pairs)
     assert {p: warmed.distinct_pair_count(*p) for p in pairs} == expect
+
+
+def test_weak_label_mask_matches_domain_top_value():
+    """compute_weak_label_mask must demote exactly the cells whose top
+    domain value (as compute_domain_in_error_cells orders it) equals the
+    current value — the two consumers share per-attribute scaffolding and
+    this pins their agreement."""
+    import numpy as np
+    import pandas as pd
+
+    from delphi_tpu.ops.domain import (
+        compute_domain_in_error_cells, compute_weak_label_mask)
+    from delphi_tpu.ops.entropy import compute_pairwise_stats
+    from delphi_tpu.ops.freq import compute_freq_stats
+    from delphi_tpu.table import discretize_table, encode_table
+
+    rng = np.random.RandomState(9)
+    n = 400
+    base = rng.randint(0, 6, n)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "a": np.array([f"A{v}" for v in base], dtype=object),
+        "b": np.array([f"B{v}" for v in (base + rng.binomial(1, 0.1, n)) % 6],
+                      dtype=object),
+        "c": np.array([f"C{v}" for v in rng.randint(0, 4, n)], dtype=object),
+    })
+    table = encode_table(df, "tid")
+    disc = discretize_table(table, 80)
+    domain_stats = disc.domain_stats
+    attrs = disc.table.column_names
+    pairs = [(x, y) for x in attrs for y in attrs if x != y]
+    freq = compute_freq_stats(disc.table, attrs, pairs, 0.0)
+    pairwise = compute_pairwise_stats(n, freq, pairs, domain_stats)
+    for t in attrs:
+        pairwise.setdefault(t, [])
+
+    cells_rows = rng.choice(n, 120, replace=False).astype(np.int64)
+    cells_attrs = np.array(
+        [attrs[i % len(attrs)] for i in range(120)], dtype=object)
+    currents = np.array(
+        [str(df.at[int(r), a]) for r, a in zip(cells_rows, cells_attrs)],
+        dtype=object)
+    cells = (cells_rows, cells_attrs, currents)
+
+    args = (disc, cells, [], attrs, freq, pairwise, domain_stats, 4, 0.0, 0.1)
+    mask = compute_weak_label_mask(*args)
+    doms = compute_domain_in_error_cells(*args)
+    by_key = {(d.row_index, d.attribute): d for d in doms}
+    expected = np.array([
+        bool(by_key[(int(r), a)].domain)
+        and by_key[(int(r), a)].domain[0][0] == cur
+        for r, a, cur in zip(cells_rows, cells_attrs, currents)])
+    assert (mask == expected).all()
+    assert expected.any(), "test should exercise at least one demotion"
