@@ -476,3 +476,45 @@ def test_one_tuple_dc_minimal_repair(adult, session):
     assert cells[(4, "Sex")] == "Male"
     assert (4, "Relationship") not in cells
     assert cells[(11, "Sex")] == "Male"
+
+
+def test_onehot_design_matches_dense_logreg():
+    """The factored one-hot design must reproduce the dense matrix exactly,
+    and the gather-trained logistic head must agree with the dense-trained
+    one (same loss surface); a compact-fitted model must also serve DENSE
+    inputs through its reconstructed weights."""
+    from delphi_tpu.models.encoding import FeatureEncoder
+    from delphi_tpu.models.linear import LogisticRegressionModel
+
+    rng = np.random.RandomState(7)
+    n = 600
+    df = pd.DataFrame({
+        "a": rng.randint(0, 12, n).astype(str),
+        "b": rng.randint(0, 30, n).astype(str),
+        "c": rng.randint(0, 5, n).astype(str),
+        "num": rng.randn(n),
+    })
+    y = pd.Series(((df["a"].astype(int) * 3 + df["c"].astype(int)) % 9)
+                  .astype(str))
+
+    enc = FeatureEncoder(list(df.columns), ["num"])
+    Xd = enc.fit_transform(df)
+    Xc = enc.transform_compact(df)
+    np.testing.assert_allclose(Xc.dense(), Xd)
+
+    md = LogisticRegressionModel(n_steps=120)
+    md.fit(Xd, y)
+    mc = LogisticRegressionModel(n_steps=120)
+    mc.fit(Xc, y)
+    # the point of the test is the GATHER path — fail loudly if environment
+    # routing (mesh/env overrides) silently sent mc down the dense path
+    assert mc._compact is not None
+    pd_dense = md.predict_proba(Xd)
+    pd_compact = mc.predict_proba(Xc)
+    agree = (pd_dense.argmax(1) == pd_compact.argmax(1)).mean()
+    assert agree > 0.99, f"gather vs dense logreg diverge: {agree:.3f}"
+    assert abs(md.loss_ - mc.loss_) < 1e-3
+
+    # dense input into the compact-fitted model: reconstructed weights
+    pd_cross = mc.predict_proba(Xd)
+    np.testing.assert_allclose(pd_cross, pd_compact, atol=1e-5)
