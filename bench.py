@@ -82,14 +82,21 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
         "table_name": "hospital_big", "row_id": "tid",
         "target_attr_list": "ZipCode,City,State", "null_ratio": "0.03",
         "seed": "0"}).injectNull()
-    delphi.register_table("hospital_dirty", injected)
     # memory hygiene at large --scale: only the dirty table is repaired, so
-    # drop the clean copy (catalog + locals) before the timed run — at 50M
-    # rows the pre-injection frame alone is tens of GB
+    # drop the clean copy (catalog + locals) BEFORE encoding — at 50M rows
+    # the pre-injection frame alone is tens of GB and the encode below must
+    # not run on top of it
     from delphi_tpu.session import get_session
     get_session().drop("hospital_big")
     n_rows = int(len(big))
-    del big, injected
+    del big
+    # register the ENCODED table (the production ingestion path — chunked
+    # CSV ingestion lands catalog entries this way), so run() validates the
+    # codes instead of re-encoding 19 object columns under peak memory
+    # pressure; at 1e8 rows that re-encode alone cost ~13 min of the run
+    from delphi_tpu.table import encode_table
+    delphi.register_table("hospital_dirty", encode_table(injected, "tid"))
+    del injected
 
     jax.block_until_ready(jax.numpy.zeros(8).sum())
 
