@@ -124,8 +124,7 @@ class _AttrGroup:
         shard shape."""
         assert self._ctx is not None
         pair_tables, taus, corr_codes, has_single, n = self._ctx
-        chunk = max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS",
-                                          "1000000")))
+        chunk = _chunk_cells()
         operand_cache: dict = {}  # chunk-invariant device operands
         for lo in range(0, len(self.rows), chunk):
             sub_rows = self.rows[lo:lo + chunk]
@@ -134,6 +133,24 @@ class _AttrGroup:
                 codes_chunk, pair_tables, taus, has_single, n,
                 operand_cache=operand_cache)
             yield lo, prob, contributed
+
+    def weak_label_chunks(self, vocab_rank: np.ndarray, beta: float):
+        """Yields (chunk offset, has_domain [cells], top value index
+        [cells]) through the FUSED device kernel — same chunking as
+        :meth:`score_chunks`, but only per-cell scalars return to the
+        host (the weak-label mask's dominant cost at north-star scale was
+        host passes over the [cells, v_a] matrices)."""
+        assert self._ctx is not None
+        pair_tables, taus, corr_codes, has_single, n = self._ctx
+        chunk = _chunk_cells()
+        operand_cache: dict = {}
+        for lo in range(0, len(self.rows), chunk):
+            sub_rows = self.rows[lo:lo + chunk]
+            codes_chunk = [c[sub_rows] for c in corr_codes]
+            has_domain, top = _weak_label_chunk_device(
+                codes_chunk, pair_tables, taus, has_single, vocab_rank,
+                beta, n, operand_cache)
+            yield lo, has_domain, top
 
 
 def _iter_attr_groups(disc: DiscretizedTable,
@@ -211,6 +228,8 @@ def compute_weak_label_mask(
     Python list build (which dominated the phase at the 1e8-row north
     star)."""
     assert max_attrs_to_compute_domains > 0
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
     table = disc.table
     demote = np.zeros(len(cells[0]), dtype=bool)
 
@@ -229,6 +248,28 @@ def compute_weak_label_mask(
         vocab_rank = np.empty(len(vocab), dtype=np.int64)
         vocab_rank[order] = np.arange(len(vocab))
 
+        assert group._ctx is not None
+        pair_tables, taus, corr_codes, has_single, n = group._ctx
+        max_count = max((int(t.max(initial=0)) for t in pair_tables),
+                        default=0)
+        # Fused device path: scoring + beta mask + top-value pick run in one
+        # jitted program and only per-cell scalars come back — the dominant
+        # phase-1 cost at the 1e8-row north star was exactly these host
+        # passes over [cells, v_a] matrices. Same int32/float64 contract as
+        # the other routes (bit-identical demotions).
+        fused = mesh is None \
+            and len(pair_tables) * max(max_count, 1) < 2 ** 31 \
+            and (len(group.rows) >= 65536
+                 or os.environ.get("DELPHI_DOMAIN_DEVICE") == "1")
+        if fused:
+            for lo, has_domain, top in group.weak_label_chunks(vocab_rank,
+                                                               beta):
+                eq = vocab_str[np.minimum(top, len(vocab) - 1)] \
+                    == group.currents[lo:lo + len(top)]
+                demote[group.pos[lo:lo + len(top)]] = \
+                    has_domain & eq.astype(bool)
+            continue
+
         for lo, prob, contributed in group.score_chunks():
             masked = np.where(contributed & (prob > beta), prob, -np.inf)
             best_p = masked.max(axis=1)
@@ -245,52 +286,52 @@ def compute_weak_label_mask(
 _score_kernel = None
 
 
+def _int_score_body(codes, tables, taus_arr, hs):
+    """The ONE scoring body every jitted route shares (plain traceable
+    function): per-correlate pair-count gather, tau/NULL/singleton
+    activation, and the exact integer split big = sum(cnt-1 | cnt>=2),
+    tiny = #(cnt==1). Any semantic fix lands here once."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(codes_c, table_c, tau):
+        gathered = table_c[codes_c + 1][:, 1:]      # [cells, v_a]
+        valid = (codes_c != -1)[:, None]
+        active = (gathered > tau) & (gathered > 0) & valid & hs[None, :]
+        big = jnp.where(active & (gathered >= 2), gathered - 1, 0)
+        tiny = (active & (gathered == 1)).astype(jnp.int32)
+        return big, tiny, active
+
+    bigs, tinys, actives = jax.vmap(one, in_axes=(0, 0, 0))(
+        codes, tables, taus_arr)
+    return bigs.sum(axis=0), tinys.sum(axis=0), actives.any(axis=0)
+
+
 def _jit_score_kernel():
     import jax
+    return jax.jit(_int_score_body)
+
+
+def _chunk_cells() -> int:
+    return max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS", "1000000")))
+
+
+def _pad_chunk_operands(codes_chunk, pair_tables, taus, has_single,
+                        operand_cache, vocab_rank=None):
+    """Pads + uploads the device operands shared by the jitted scoring
+    routes. The chunk-invariant pieces (pair tables, taus, masks, optional
+    vocab ranks) build once per attribute group via ``operand_cache``; the
+    per-chunk codes pad to 65536-row buckets so chunk-size variation does
+    not churn compiles. Returns the padded codes (numpy) plus
+    (cells, v_a)."""
     import jax.numpy as jnp
 
-    @jax.jit
-    def kernel(codes, tables, taus_arr, hs):
-        def one(codes_c, table_c, tau):
-            gathered = table_c[codes_c + 1][:, 1:]      # [cells, v_a]
-            valid = (codes_c != -1)[:, None]
-            active = (gathered > tau) & (gathered > 0) & valid & hs[None, :]
-            big = jnp.where(active & (gathered >= 2), gathered - 1, 0)
-            tiny = (active & (gathered == 1)).astype(jnp.int32)
-            return big, tiny, active
-
-        bigs, tinys, actives = jax.vmap(one, in_axes=(0, 0, 0))(
-            codes, tables, taus_arr)
-        return bigs.sum(axis=0), tinys.sum(axis=0), actives.any(axis=0)
-
-    return kernel
-
-
-def _score_cells_device(codes_chunk, pair_tables, taus, has_single,
-                        operand_cache=None):
-    """Single-device jitted scoring: XLA fuses the gather + compares into
-    one pass (measured ~4.6x over the numpy path at 1M cells on the CPU
-    backend — numpy materializes a temporary per comparison). Shapes pad to
-    coarse buckets so chunk-size/vocab variation doesn't churn compiles;
-    int32 accumulators under the same 2^31 guard as the mesh kernel, so
-    results are bit-identical to the numpy path. ``operand_cache`` (a dict
-    owned by the per-attribute chunk iterator) holds the padded
-    tables/taus/mask device arrays, which are chunk-invariant — without it
-    every chunk of a big attribute re-pads and re-uploads them."""
-    global _score_kernel
-    import jax
-    import jax.numpy as jnp
-
-    if _score_kernel is None:
-        _score_kernel = _jit_score_kernel()
     k = len(codes_chunk)
     cells = len(codes_chunk[0])
     v_a = int(has_single.shape[0])
     va_pad = -(-v_a // 32) * 32
     n_pad = -(-cells // 65536) * 65536
 
-    if operand_cache is None:
-        operand_cache = {}
     if "tables" not in operand_cache:
         vc_max = max(int(t.shape[0]) for t in pair_tables)
         vc_pad = max(8, 1 << (vc_max - 1).bit_length())
@@ -303,17 +344,98 @@ def _score_cells_device(codes_chunk, pair_tables, taus, has_single,
         operand_cache["taus"] = jnp.asarray(
             np.asarray([max(int(t), 0) for t in taus], np.int32))
         operand_cache["hs"] = jnp.asarray(hs)
+        if vocab_rank is not None:
+            # padded vocab slots: never active (hs False), and their rank
+            # sits past every real rank so argmin cannot pick them
+            rank = np.full(va_pad, np.iinfo(np.int32).max - 1, np.int32)
+            rank[:v_a] = np.asarray(vocab_rank, np.int32)
+            operand_cache["rank"] = jnp.asarray(rank)
 
     codes = np.full((k, n_pad), -1, np.int32)
     for i, c in enumerate(codes_chunk):
         codes[i, :cells] = c
+    return codes, cells, v_a
 
+
+def _score_cells_device(codes_chunk, pair_tables, taus, has_single,
+                        operand_cache=None):
+    """Single-device jitted scoring: XLA fuses the gather + compares into
+    one pass (measured ~4.6x over the numpy path at 1M cells on the CPU
+    backend — numpy materializes a temporary per comparison). int32
+    accumulators under the same 2^31 guard as the mesh kernel, so results
+    are bit-identical to the numpy path. ``operand_cache`` (a dict owned by
+    the per-attribute chunk iterator) holds the padded chunk-invariant
+    device operands."""
+    global _score_kernel
+    import jax.numpy as jnp
+
+    if _score_kernel is None:
+        _score_kernel = _jit_score_kernel()
+    if operand_cache is None:
+        operand_cache = {}
+    codes, cells, v_a = _pad_chunk_operands(
+        codes_chunk, pair_tables, taus, has_single, operand_cache)
     big, tiny, contributed = _score_kernel(
         jnp.asarray(codes), operand_cache["tables"], operand_cache["taus"],
         operand_cache["hs"])
     return (np.asarray(big)[:cells, :v_a].astype(np.int64),
             np.asarray(tiny)[:cells, :v_a].astype(np.int64),
             np.asarray(contributed)[:cells, :v_a])
+
+
+_weak_kernel = None
+
+
+def _jit_weak_label_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(codes, tables, taus_arr, hs, vocab_rank, beta, n_rows):
+        big, tiny, contributed = _int_score_body(codes, tables, taus_arr, hs)
+        # float64 recombination with the same elementwise formula as
+        # _combine_scores (runs under enable_x64). CAVEAT: the per-row
+        # normalizer is an XLA reduction whose association order is not
+        # guaranteed to match numpy's pairwise summation, so a probability
+        # within one ulp of beta can flip its demote bit vs the host route;
+        # tie equality is unaffected (same normalizer divides both sides).
+        score = big.astype(jnp.float64) + 0.1 * tiny.astype(jnp.float64)
+        score = score / n_rows
+        denom = score.sum(axis=1, keepdims=True)
+        prob = jnp.where(denom > 0, score / denom, 0.0)
+        masked = jnp.where(contributed & (prob > beta), prob, -jnp.inf)
+        best = masked.max(axis=1)
+        has_domain = best > -jnp.inf
+        ties = masked == best[:, None]
+        rank_masked = jnp.where(ties, vocab_rank[None, :],
+                                jnp.iinfo(jnp.int32).max)
+        top = jnp.argmin(rank_masked, axis=1).astype(jnp.int32)
+        return has_domain, top
+
+    return kernel
+
+
+def _weak_label_chunk_device(codes_chunk, pair_tables, taus, has_single,
+                             vocab_rank, beta, n_rows, operand_cache):
+    """Fused device evaluation of one weak-label chunk: scoring, beta
+    masking and the rank-tie-broken top-value pick all run inside one
+    jitted program, so only two [cells]-sized arrays come back to the host
+    (the [cells, v_a] probability matrices never materialize)."""
+    global _weak_kernel
+    import jax.numpy as jnp
+    from jax import enable_x64
+
+    if _weak_kernel is None:
+        _weak_kernel = _jit_weak_label_kernel()
+    with enable_x64():
+        codes, cells, v_a = _pad_chunk_operands(
+            codes_chunk, pair_tables, taus, has_single, operand_cache,
+            vocab_rank=vocab_rank)
+        has_domain, top = _weak_kernel(
+            jnp.asarray(codes), operand_cache["tables"],
+            operand_cache["taus"], operand_cache["hs"],
+            operand_cache["rank"], float(beta), float(n_rows))
+        return (np.asarray(has_domain)[:cells], np.asarray(top)[:cells])
 
 
 def _score_cells(codes_chunk: List[np.ndarray],

@@ -261,3 +261,51 @@ def test_weak_label_mask_matches_domain_top_value():
         for r, a, cur in zip(cells_rows, cells_attrs, currents)])
     assert (mask == expected).all()
     assert expected.any(), "test should exercise at least one demotion"
+
+
+def test_weak_label_fused_device_path_matches_numpy(monkeypatch):
+    """The fused device weak-label kernel (scoring + beta mask + top pick in
+    one jitted program) must produce the exact demotion mask of the numpy
+    path — DELPHI_DOMAIN_DEVICE=1 forces it below the size threshold."""
+    import numpy as np
+    import pandas as pd
+
+    from delphi_tpu.ops.domain import compute_weak_label_mask
+    from delphi_tpu.ops.entropy import compute_pairwise_stats
+    from delphi_tpu.ops.freq import compute_freq_stats
+    from delphi_tpu.table import discretize_table, encode_table
+
+    rng = np.random.RandomState(21)
+    n = 600
+    base = rng.randint(0, 7, n)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "a": np.array([f"A{v}" for v in base], dtype=object),
+        "b": np.array([f"B{v}" for v in (base + rng.binomial(1, 0.15, n)) % 7],
+                      dtype=object),
+        "c": np.array([f"C{v}" for v in rng.randint(0, 5, n)], dtype=object),
+    })
+    table = encode_table(df, "tid")
+    disc = discretize_table(table, 80)
+    attrs = disc.table.column_names
+    pairs = [(x, y) for x in attrs for y in attrs if x != y]
+    freq = compute_freq_stats(disc.table, attrs, pairs, 0.0)
+    pairwise = compute_pairwise_stats(n, freq, pairs, disc.domain_stats)
+    for t in attrs:
+        pairwise.setdefault(t, [])
+
+    rows = rng.choice(n, 150, replace=False).astype(np.int64)
+    cell_attrs = np.array([attrs[i % len(attrs)] for i in range(150)],
+                          dtype=object)
+    currents = np.array(
+        [str(df.at[int(r), a]) for r, a in zip(rows, cell_attrs)],
+        dtype=object)
+    args = (disc, (rows, cell_attrs, currents), [], attrs, freq, pairwise,
+            disc.domain_stats, 4, 0.0, 0.1)
+
+    monkeypatch.delenv("DELPHI_DOMAIN_DEVICE", raising=False)
+    mask_numpy = compute_weak_label_mask(*args)
+    monkeypatch.setenv("DELPHI_DOMAIN_DEVICE", "1")
+    mask_fused = compute_weak_label_mask(*args)
+    assert (mask_numpy == mask_fused).all()
+    assert mask_numpy.any()
