@@ -68,6 +68,7 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
     from delphi_tpu import NullErrorDetector, delphi
 
     device = str(jax.devices()[0])
+    _heartbeat(f"hospital-scale prep (scale={scale})")
     hospital = pd.read_csv("/root/reference/testdata/hospital.csv", dtype=str)
     parts = []
     for i in range(scale):
@@ -98,7 +99,9 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
     delphi.register_table("hospital_dirty", encode_table(injected, "tid"))
     del injected
 
+    _heartbeat("device warmup (first dispatch)")
     jax.block_until_ready(jax.numpy.zeros(8).sum())
+    _heartbeat("repair.run()")
 
     util = None
     if profile:
@@ -170,7 +173,9 @@ def flights(scale: int, profile: bool = False) -> None:
     session.register("flights_error_cells", error_cells)
 
     # warm-up: trigger jax backend init so the bench measures the pipeline
+    _heartbeat("device warmup (first dispatch)")
     jax.block_until_ready(jax.numpy.zeros(8).sum())
+    _heartbeat("repair.run()")
 
     util = None
     if profile:
@@ -271,6 +276,14 @@ def _persist_tpu_result(args: argparse.Namespace, parsed: dict) -> None:
         print(f"could not persist TPU result: {e}", file=sys.stderr)
 
 
+def _heartbeat(msg: str) -> None:
+    """Progress line on stderr: a killed child's captured tail must name the
+    step it died in (backend init vs compile vs a pipeline phase), not just
+    the backend-init warning — round 4's TPU timeouts were undiagnosable."""
+    print(f"PHASE>> {time.strftime('%H:%M:%S')} {msg}",
+          file=sys.stderr, flush=True)
+
+
 def _child_main(args: argparse.Namespace) -> None:
     if os.environ.get("DELPHI_BENCH_LOG"):
         # surface the pipeline's phase narration (timestamps included) so
@@ -283,11 +296,15 @@ def _child_main(args: argparse.Namespace) -> None:
         _force_cpu_backend()
     # delphi_tpu's import-time env setup (XLA:CPU ISA cap, compile-cache
     # scoping) must land BEFORE the first backend touch to take effect
+    _heartbeat("importing delphi_tpu")
     import delphi_tpu  # noqa: F401
     # Initialize the backend up front and announce it, so the parent can
     # bound backend init separately from the (long) workload budget.
+    _heartbeat("backend init (jax.devices)")
     import jax
-    print(f"{_READY_SENTINEL} {jax.devices()[0]}", flush=True)
+    dev = jax.devices()[0]
+    _heartbeat(f"backend ready: {dev}")
+    print(f"{_READY_SENTINEL} {dev}", flush=True)
     if args.workload == "hospital-scale":
         hospital_scale(args.scale, profile=args.profile)
     else:
@@ -317,6 +334,9 @@ def _spawn_child(args: argparse.Namespace, backend: str, init_timeout: int,
 
     env = dict(os.environ)
     env["DELPHI_BENCH_BACKEND"] = backend
+    # per-phase heartbeats on the child's stderr: a killed run's tail then
+    # names the phase it died in (persisted into backend_fallback below)
+    env.setdefault("DELPHI_PHASE_HEARTBEAT", "1")
     cmd = [sys.executable, os.path.abspath(__file__), "--_child",
            "--workload", args.workload, "--scale", str(args.scale)]
     if args.profile:

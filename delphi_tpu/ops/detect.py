@@ -198,12 +198,57 @@ def _one_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     return mask
 
 
+_x64_device_ok: Optional[bool] = None
+
+
+def _device_x64_ok() -> bool:
+    """True iff the default backend really computes in 64-bit: the device
+    detect kernels need int64 keys (fused group keys overflow int32 at
+    scale) and float64 comparison values (f32 rounding flips LT/GT verdicts
+    vs the host numpy path). TPU backends support f64/i64 only partially
+    (unsupported or software-emulated depending on the XLA version), so the
+    capability is PROBED once — a tiny sort/searchsorted/segment_max under
+    enable_x64 whose results must round-trip bit-exactly — instead of
+    assumed. A failed or degraded probe keeps detection on the host path."""
+    global _x64_device_ok
+    if _x64_device_ok is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            from jax import enable_x64
+            with enable_x64():
+                keys = jnp.asarray(
+                    np.array([3, (1 << 40) + 1, 1 << 40], dtype=np.int64))
+                s = jnp.sort(keys)
+                hits = jnp.searchsorted(s, keys, side="right") \
+                    - jnp.searchsorted(s, keys, side="left")
+                vals = jnp.asarray(
+                    np.array([1.0 + 2.0 ** -40, 1.0], dtype=np.float64))
+                ext = jax.ops.segment_max(
+                    vals, jnp.asarray(np.array([0, 0], dtype=np.int64)),
+                    num_segments=1)
+                jax.block_until_ready((s, hits, ext))
+                ok = (s.dtype == jnp.int64
+                      and ext.dtype == jnp.float64
+                      and int(np.asarray(s)[-1]) == (1 << 40) + 1
+                      and np.array_equal(np.asarray(hits), [1, 1, 1])
+                      and float(np.asarray(ext)[0]) == 1.0 + 2.0 ** -40)
+            _x64_device_ok = bool(ok)
+        except Exception:  # unsupported dtype / lowering error -> host path
+            _x64_device_ok = False
+        if not _x64_device_ok:
+            _logger.info("device x64 probe failed; detection stays on host")
+    return _x64_device_ok
+
+
 def _use_device_detect(n: int) -> bool:
     """Routes the single-EQ constraint kernels (and large percentile scans)
     onto the accelerator: on TPU the sort/searchsorted programs keep the
     violation scan off the host entirely (reference: every detector is a
     distributed Spark job, ErrorDetectorApi.scala:128-300); the CPU backend
     keeps the numpy path, whose factorize/bincount beats XLA:CPU sorts.
+    Gated on the x64 capability probe — a backend that cannot compute the
+    kernels bit-compatibly with host numpy keeps the host path.
     DELPHI_DEVICE_DETECT=1/0 forces the choice (tests use 1 to prove
     device/host equivalence on the CPU backend)."""
     import os
@@ -213,7 +258,7 @@ def _use_device_detect(n: int) -> bool:
     if setting == "0":
         return False
     import jax
-    return n >= 4096 and jax.default_backend() != "cpu"
+    return n >= 4096 and jax.default_backend() != "cpu" and _device_x64_ok()
 
 
 def _pad_pow2(arr, fill):

@@ -44,7 +44,18 @@ def get_active_mesh() -> Optional[Mesh]:
     setting = setting.strip().lower()
     if setting == "":
         if "__default__" not in _active_mesh_cache:
-            _active_mesh_cache["__default__"] = _default_mesh()
+            mesh, cacheable = _default_mesh()
+            if not cacheable:
+                # transient backend-init failure: answer single-device for
+                # THIS call and retry next time — but only a few times, so a
+                # PERSISTENTLY broken backend doesn't pay a re-init attempt
+                # on every stats op for the process lifetime
+                fails = _active_mesh_cache.get("__probe_failures__", 0) + 1
+                _active_mesh_cache["__probe_failures__"] = fails
+                if fails < 3:
+                    return None
+                mesh = None
+            _active_mesh_cache["__default__"] = mesh
         return _active_mesh_cache["__default__"]
     if setting in ("0", "off", "none"):
         return None
@@ -68,22 +79,23 @@ def get_active_mesh() -> Optional[Mesh]:
     return _active_mesh_cache[key]
 
 
-def _default_mesh() -> Optional[Mesh]:
+def _default_mesh() -> Tuple[Optional[Mesh], bool]:
     """The no-configuration default: a dp mesh over all devices when the
     backend is TPU with >1 device, or when running multi-process (where the
     mesh is the only way the cluster's devices cooperate). CPU/GPU
     single-process defaults stay single-device — virtual CPU meshes are a
-    TESTING construct, opted into via DELPHI_MESH."""
+    TESTING construct, opted into via DELPHI_MESH. Returns (mesh, cacheable):
+    a failed backend probe is NOT cacheable — the caller must retry it."""
     from delphi_tpu.parallel.distributed import maybe_initialize_distributed
     maybe_initialize_distributed()
     try:
         n = len(jax.devices())
         backend = jax.default_backend()
-    except Exception:  # backend init failure -> single-device semantics
-        return None
+    except Exception:  # backend init failure -> single-device, uncached
+        return None, False
     if n > 1 and (backend == "tpu" or jax.process_count() > 1):
-        return make_mesh()
-    return None
+        return make_mesh(), True
+    return None, True
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -172,6 +172,19 @@ def log_based_on_level(msg: Any) -> None:
     _logger.log(level, msg() if callable(msg) else msg)
 
 
+def _phase_heartbeat(marker: str, text: str) -> None:
+    """Unbuffered per-phase progress line on stderr, enabled by
+    ``DELPHI_PHASE_HEARTBEAT=1``. Exists so a supervisor that has to kill a
+    hung run (bench.py's two-phase deadline) finds WHICH phase died in the
+    captured stderr tail — round 4's TPU timeouts recorded nothing but the
+    backend-init warning, leaving 'tunnel down' and 'stuck in compile'
+    indistinguishable."""
+    if os.environ.get("DELPHI_PHASE_HEARTBEAT") == "1":
+        import sys
+        print(f"PHASE{marker} {time.strftime('%H:%M:%S')} {text}",
+              file=sys.stderr, flush=True)
+
+
 class phase_span:
     """Phase-scoped timing span: the TPU-native analog of the reference's
     `@spark_job_group` (`utils.py:130-146`) + Spark job descriptions.
@@ -191,6 +204,7 @@ class phase_span:
 
     def __enter__(self) -> "phase_span":
         phase_span._active.append(self.name)
+        _phase_heartbeat(">>", "/".join(phase_span._active))
         try:
             import jax.profiler
             self._annotation = jax.profiler.TraceAnnotation(self.name)
@@ -203,8 +217,11 @@ class phase_span:
     def __exit__(self, *exc: Any) -> None:
         if self._annotation is not None:
             self._annotation.__exit__(None, None, None)
+        elapsed = time.time() - self._t0
+        _phase_heartbeat("<<", f"{'/'.join(phase_span._active)} "
+                               f"({elapsed:.1f}s)")
         phase_span._active.pop()
-        _logger.info(f"Elapsed time (name: {self.name}) is {time.time() - self._t0}(s)")
+        _logger.info(f"Elapsed time (name: {self.name}) is {elapsed}(s)")
 
 
 class profile_trace:
