@@ -324,21 +324,17 @@ class RepairModel:
 
     @argtype_check  # type: ignore
     def setParallelStatTrainingEnabled(self, enabled: bool) -> "RepairModel":
-        """Reference API parity for the
-        pandas-UDF training fan-out (reference model.py:383-395): here
-        per-attribute training already runs as batched device launches (and
-        shards over the mesh under ``DELPHI_MESH``), so both settings take
-        the same path.
+        """Selects BATCHED multi-target training for
+        phase 2 — the TPU-native analog of the reference's parallel
+        pandas-UDF fan-out (reference model.py:383-395): every pending
+        target's CV search and final fit stack into shared vmapped device
+        launches (see :func:`delphi_tpu.train.build_models_batched`)
+        instead of running one target at a time. Accelerator backends take
+        the batched path by default; this flag opts the CPU backend in too
+        (``DELPHI_BATCH_TRAIN=1/0`` force-overrides either way).
 
-        :param enabled: accepted for compatibility.
+        :param enabled: ``True`` to batch per-attribute training.
         """
-        if enabled:
-            _logger.info(
-                "setParallelStatTrainingEnabled: per-attribute training "
-                "already runs as batched device launches (and shards over "
-                "the mesh when DELPHI_MESH is set), so this flag selects the "
-                "same path as the default — accepted for API parity with the "
-                "reference's pandas-UDF fan-out (model.py:383-395)")
         self.parallel_stat_training_enabled = enabled
         return self
 
@@ -697,6 +693,62 @@ class RepairModel:
                 frac=ratio, random_state=42).to_numpy()
         return positions
 
+    def _prepare_training_task(self, y: str, masked: EncodedTable,
+                               float_cols: Tuple[str, ...],
+                               continuous_columns: List[str],
+                               feature_map: Dict[str, List[str]],
+                               transformer_map: Dict[str, List[Any]]) \
+            -> Optional[Tuple[Any, Any, int]]:
+        """Host-side training-set assembly for one target: sample to the
+        row cap, decode only the sample to pandas, fit-encode features,
+        optionally rebalance. Returns (X, y_series, n_rows) or None when
+        the target has no clean rows."""
+        y_codes = masked.column(y).codes
+        valid_pos = np.flatnonzero(y_codes >= 0)
+        if len(valid_pos) == 0:
+            return None
+        sel_pos = self._sample_training_positions(valid_pos)
+        train_pdf = masked.to_pandas(
+            rows=sel_pos, columns=list(feature_map[y]) + [y],
+            integral_as_float=float_cols)
+        is_discrete = y not in continuous_columns
+        # linear-head targets train from the factored one-hot design —
+        # gathers instead of dense-width matmuls (rebalancing needs row
+        # indexing, so it keeps dense)
+        X: Any = self._encode_features(
+            transformer_map[y], train_pdf[feature_map[y]], fit=True,
+            compact=not (is_discrete
+                         and self.training_data_rebalancing_enabled))
+        if is_discrete and self.training_data_rebalancing_enabled:
+            X, y_ = rebalance_training_data(X, train_pdf[y], y)
+        else:
+            y_ = train_pdf[y]
+        return X, y_, len(train_pdf)
+
+    def _use_batched_training(self, n_pending: int) -> bool:
+        """Whether phase 2 trains its targets through the BATCHED path
+        (`train.build_models_batched`): multi-target CV searches and final
+        fits stack into shared vmapped launches — the TPU-native analog of
+        the reference's parallel pandas-UDF fan-out (model.py:817-926).
+        Selected by ``setParallelStatTrainingEnabled(True)``, and by
+        default on accelerator backends, where N small sequential fits are
+        exactly the launch-bound profile that leaves the device idle; the
+        CPU backend defaults to the sequential path (same total FLOPs, and
+        the batched group fit pays for the group's max round budget).
+        ``DELPHI_BATCH_TRAIN=1/0`` forces the choice."""
+        import os
+        setting = os.environ.get("DELPHI_BATCH_TRAIN", "auto")
+        if setting == "1":
+            return True
+        if setting == "0":
+            return False
+        if n_pending <= 1:
+            return False
+        if self.parallel_stat_training_enabled:
+            return True
+        import jax
+        return jax.default_backend() != "cpu"
+
     def _build_repair_stat_models(
             self, models: Dict[str, Any], masked: EncodedTable,
             float_cols: Tuple[str, ...],
@@ -704,47 +756,77 @@ class RepairModel:
             num_class_map: Dict[str, int],
             feature_map: Dict[str, List[str]],
             transformer_map: Dict[str, List[Any]]) -> Dict[str, Any]:
-        """Builds the remaining per-attribute stat models. The reference's
-        parallel pandas-UDF fan-out (model.py:817-926) is unnecessary here:
-        each jitted trainer already saturates the device, so both the 'series'
-        and 'parallel' settings take this path. Training rows decode lazily:
-        only the (capped) per-target sample ever materializes to pandas."""
-        for y in [c for c in target_columns if c not in models]:
+        """Builds the remaining per-attribute stat models. Two routes
+        (selection: `_use_batched_training`): the batched path trains every
+        target's CV search and final fit in shared vmapped device launches
+        (reference's parallel fan-out, model.py:817-926); the sequential
+        path fits one target at a time. Training rows decode lazily either
+        way: only the (capped) per-target sample ever materializes to
+        pandas."""
+        pending = [c for c in target_columns if c not in models]
+
+        if self._use_batched_training(len(pending)):
+            tasks = []
+            for y in pending:
+                # progress index counts prior models AND queued tasks, so
+                # the Building/Skipping lines stay monotonic like the
+                # sequential branch's
+                index = len(models) + len(tasks) + 1
+                prep = self._prepare_training_task(
+                    y, masked, float_cols, continuous_columns, feature_map,
+                    transformer_map)
+                if prep is None:
+                    _logger.info(
+                        "Skipping {}/{} model... type=classfier y={} "
+                        "num_class={}".format(index, len(target_columns), y,
+                                              num_class_map[y]))
+                    models[y] = (PoorModel(None), feature_map[y], None)
+                    continue
+                X, y_, n_rows = prep
+                is_discrete = y not in continuous_columns
+                _logger.info(
+                    "Building {}/{} model... type={} y={} features={} "
+                    "#rows={}{}".format(
+                        index, len(target_columns),
+                        "classfier" if is_discrete else "regressor", y,
+                        to_list_str(feature_map[y]), n_rows,
+                        f" #class={num_class_map[y]}"
+                        if num_class_map[y] > 0 else ""))
+                tasks.append((y, X, y_, is_discrete, num_class_map[y]))
+            if tasks:
+                from delphi_tpu.train import build_models_batched
+                _logger.info(
+                    f"Training {len(tasks)} models in batched device "
+                    "launches...")
+                out = build_models_batched(tasks, self.opts)
+                for y, X, y_, is_discrete, num_class in tasks:
+                    (model, score), elapsed = out[y]
+                    if model is None:
+                        model = PoorModel(None)
+                    _logger.info(
+                        f"Finishes building '{y}' model...  score={score} "
+                        f"elapsed={elapsed}s")
+                    models[y] = (model, feature_map[y], transformer_map[y])
+            return models
+
+        for y in pending:
             index = len(models) + 1
-            y_codes = masked.column(y).codes
-            valid_pos = np.flatnonzero(y_codes >= 0)
-            training_data_num = len(valid_pos)
-            if training_data_num == 0:
+            prep = self._prepare_training_task(
+                y, masked, float_cols, continuous_columns, feature_map,
+                transformer_map)
+            if prep is None:
                 _logger.info(
                     "Skipping {}/{} model... type=classfier y={} num_class={}".format(
                         index, len(target_columns), y, num_class_map[y]))
                 models[y] = (PoorModel(None), feature_map[y], None)
                 continue
-
-            sel_pos = self._sample_training_positions(valid_pos)
-            train_pdf = masked.to_pandas(
-                rows=sel_pos, columns=list(feature_map[y]) + [y],
-                integral_as_float=float_cols)
+            X, y_, n_rows = prep
             is_discrete = y not in continuous_columns
             model_type = "classfier" if is_discrete else "regressor"
-
-            # linear-head targets train from the factored one-hot design —
-            # gathers instead of dense-width matmuls (rebalancing needs row
-            # indexing, so it keeps dense)
-            X: Any = self._encode_features(
-                transformer_map[y], train_pdf[feature_map[y]], fit=True,
-                compact=not (is_discrete
-                             and self.training_data_rebalancing_enabled))
-
-            if is_discrete and self.training_data_rebalancing_enabled:
-                X, y_ = rebalance_training_data(X, train_pdf[y], y)
-            else:
-                y_ = train_pdf[y]
-
             _logger.info(
                 "Building {}/{} model... type={} y={} features={} #rows={}{}".format(
                     index, len(target_columns), model_type, y,
-                    to_list_str(feature_map[y]), len(train_pdf),
+                    to_list_str(feature_map[y]), n_rows,
                     f" #class={num_class_map[y]}" if num_class_map[y] > 0 else ""))
             (model, score), elapsed = build_model(
                 X, y_, is_discrete, num_class_map[y], n_jobs=-1, opts=self.opts)

@@ -428,16 +428,23 @@ def _mesh_predict(mesh, bins, feats, thrs, leaves, n_rounds, depth,
 # Batched cross-validation grid search
 # ---------------------------------------------------------------------------
 
-def _cv_stats(F, y, val_mask, y_cmp, log_flag, cw_corr, class_valid,
-              objective, kk, axis_name):
+def _cv_stats(F, y, val_mask, y_cmp, log_flag, inv_scale, cw_corr,
+              class_valid, objective, kk, axis_name):
     """On-device CV scoring statistics from the boosting margin carry:
     a [kk, kk] confusion-count matrix over the held-out rows for
     classifiers (val_mask picks the fold's real rows; padding rows carry
-    mask 0), or [sse, count] for regressors — tiny tensors, so early
-    stopping never fetches full prediction vectors to the host."""
+    mask 0), or [scaled sse, count] for regressors — tiny tensors, so early
+    stopping never fetches full prediction vectors to the host.
+
+    Regression errors are normalized by the target's RMS (``inv_scale``)
+    before the f32 accumulation: large-magnitude targets (salary-scale SSE
+    ~1e13) would otherwise lose ~7 significant digits in float32 — f64 is
+    not an option on TPU. The host rescales the SSE back in float64, so the
+    reported score keeps the reference's -MSE semantics."""
     if objective == "regression":
         pred = jnp.where(log_flag > 0, jnp.expm1(F), F)
-        out = jnp.stack([jnp.sum(val_mask * (pred - y_cmp) ** 2),
+        err = (pred - y_cmp) * inv_scale
+        out = jnp.stack([jnp.sum(val_mask * err * err),
                          jnp.sum(val_mask)])
     else:
         if objective == "binary":
@@ -460,35 +467,40 @@ def _cv_stats(F, y, val_mask, y_cmp, log_flag, cw_corr, class_valid,
 
 @lru_cache(maxsize=128)
 def _cv_chunk_fn(mesh, chunk, depth, n_bins, n_nodes, objective, k):
-    """One early-stopping CV step: every (fold, config) instance of a shape
+    """One early-stopping CV step: every (instance, config) pair of a shape
     group advances ``chunk`` boosting rounds from its carried margin state
-    and scores its held-out rows on device. Sharing the fold tensors lets
-    XLA emit shared-rhs batched contractions for the histograms (one bin
-    one-hot read serves every config). Under a mesh, rows shard over dp
-    with psum'd histograms (reference P2, the pandas-UDF training fan-out,
-    train.py:163-209 / model.py:817-926)."""
+    and scores its held-out rows on device. An INSTANCE is a (target, fold)
+    pair — the single-target search stacks its folds, and the batched
+    multi-target path (reference P2, the pandas-UDF training fan-out,
+    model.py:817-926) stacks every pending target's folds into the same
+    launch, which is what turns phase 2 from N small sequential fits into a
+    few device-saturating ones. Per-instance scoring tensors (y_cmp,
+    cw_corr, class_valid, inv_scale) ride the vmapped axis so instances
+    from different targets scored correctly. Under a mesh, rows shard over
+    dp with psum'd histograms."""
     axis_name = "dp" if mesh is not None else None
     kk = 2 if objective == "binary" else max(k, 1)
 
-    def fn(bins, y_, weight, val_mask, y_cmp, log_flag, cw_corr, class_valid,
-           F, lrs, reg_lambdas, min_split_gains, min_child_weights):
+    def fn(bins, y_, weight, val_mask, y_cmp, log_flag, inv_scale, cw_corr,
+           class_valid, F, lrs, reg_lambdas, min_split_gains,
+           min_child_weights):
         def one(F1, lr, reg_lambda, min_split_gain, min_child_weight):
             F2 = _boost(bins, y_, weight, F1, chunk, depth, n_bins, n_nodes,
                         objective, k, lr, reg_lambda, min_split_gain,
                         min_child_weight, 0.0, axis_name=axis_name,
                         collect_trees=False, use_counts=False)
-            stats = _cv_stats(F2, y_, val_mask, y_cmp, log_flag, cw_corr,
-                              class_valid, objective, kk, axis_name)
+            stats = _cv_stats(F2, y_, val_mask, y_cmp, log_flag, inv_scale,
+                              cw_corr, class_valid, objective, kk, axis_name)
             return F2, stats
 
         return jax.vmap(one)(F, lrs, reg_lambdas, min_split_gains,
                              min_child_weights)
 
     if mesh is None:
-        # Single device: batch the FOLD axis into the same launch too —
-        # (folds × configs) instances advance in one XLA program per chunk.
+        # Single device: batch the instance axis into the same launch too —
+        # (instances × configs) advance in one XLA program per chunk.
         return jax.jit(jax.vmap(
-            fn, in_axes=(0, 0, 0, 0, None, 0, None, None, 0,
+            fn, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
                          None, None, None, None)))
 
     from jax.sharding import PartitionSpec as P
@@ -500,7 +512,7 @@ def _cv_chunk_fn(mesh, chunk, depth, n_bins, n_nodes, objective, k):
     return jax.jit(shard_map(
         fn, mesh=mesh,
         in_specs=(P("dp", None), P("dp"), P("dp"), P("dp"), P("dp"), P(),
-                  P(), P(), F_spec, P(), P(), P(), P()),
+                  P(), P(), P(), F_spec, P(), P(), P(), P()),
         out_specs=(F_spec, P())))
 
 
@@ -523,42 +535,21 @@ def _f1_from_confusion(conf: np.ndarray, k_real: int) -> float:
     return float(np.mean(f1s)) if f1s else 0.0
 
 
-def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
-                        configs: List[dict], n_splits: int,
-                        class_weight: str,
-                        template: "GradientBoostedTreesModel",
-                        timeout_s: float = 0.0) -> Tuple[int, float, int]:
-    """K-fold CV over a hyperparameter grid in one batched device launch per
-    static-shape group (configs sharing tree depth vmap together; others get
-    their own launches), with chunked EARLY STOPPING: boosting advances in
-    ``_CHUNK_ROUNDS``-round chunks, each chunk scores every instance's
-    held-out rows on device (confusion counts / SSE — no prediction fetch),
-    and a group stops once no config has improved for two consecutive
-    chunks — LightGBM's ``early_stopping_rounds`` semantics (reference
-    train.py:193-200) at chunk granularity.
-
-    Returns (best config index, its mean CV score, best round count); the
-    round count is the SMALLEST checkpoint where the winning config reached
-    its best score, so the final fit trains only as many rounds as CV
-    proved useful instead of the full round cap.
-
-    Scores match the sequential path's metrics: macro-F1 for classifiers,
-    -MSE for regressors (the scorers the reference feeds hyperopt,
-    train.py:158). Each fold bins (and, for regression, log-transforms)
-    from its training rows only, so an instance's scores match a
-    standalone per-fold fit.
-
-    ``timeout_s`` > 0 bounds the search like the reference's hyperopt
-    timeout (train.py:196): once exceeded, the best config so far wins.
-    """
-    import time
-    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+def _cv_prepare_target(X: Any, y: Any, is_discrete: bool, n_splits: int,
+                       class_weight: str,
+                       template: "GradientBoostedTreesModel",
+                       mesh: Any) -> Optional[dict]:
+    """Per-target CV preprocessing shared by the single- and multi-target
+    grid searches: factorized labels + balanced weights, per-fold binning
+    (bin edges and the regression log-target decision come from the fold's
+    TRAINING rows only, so an instance's scores match a standalone per-fold
+    fit), padded fold tensors, and the per-target scoring constants.
+    Returns None when no fold is usable (degenerate labels)."""
     Xm = template._as_matrix(X)
     n = Xm.shape[0]
-    n_bins = template.max_bin + 1
-
     y_arr = np.asarray(y)
     per_class_w = None
+    yv64 = None
     if is_discrete:
         codes, classes = pd.factorize(y_arr, sort=True)
         k_real = len(classes)
@@ -582,38 +573,31 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
             cw_corr[:m] = per_class_w[:m]
         class_valid = (np.arange(kk) < k_real).astype(np.float32)
         y_cmp = np.zeros(n, np.float32)  # unused for classifiers
+        scale = 1.0
     else:
         objective, k, k_real = "regression", 1, 0
         yv64 = pd.to_numeric(pd.Series(y_arr), errors="coerce") \
             .to_numpy(dtype=np.float64)
+        yv = yv64.astype(np.float32)
         w_full = np.ones(n)
         cw_corr = np.ones(1, np.float32)
         class_valid = np.ones(1, np.float32)
         y_cmp = yv64.astype(np.float32)  # original-space comparison target
-
-    def cfg_depth(cfg: dict) -> int:
-        return int(cfg.get("max_depth", template.max_depth))
-
-    def cfg_rounds(cfg: dict) -> int:
-        r = min(int(cfg.get("n_estimators", 200)), 200)
-        if objective == "multiclass":
-            r = min(r, max(40, 400 // k))
-        return r
+        # RMS normalizer: the on-device SSE accumulates in f32, which loses
+        # ~7 significant digits on raw salary-scale targets; errors are
+        # scored as (err/scale)^2 on device and rescaled in f64 on host
+        scale = float(np.sqrt(np.mean(yv64 ** 2))) if n else 1.0
+        if not np.isfinite(scale) or scale <= 0:
+            scale = 1.0
 
     rng = np.random.RandomState(42)
     order = rng.permutation(n)
     folds = np.array_split(order, max(2, min(n_splits, n)))
     folds = [f for f in folds if len(f)]
 
-    from delphi_tpu.parallel.mesh import get_active_mesh
-    mesh = get_active_mesh()
     n_pad = template._pad(np.zeros(n, np.float32), mesh=mesh,
                           train=True).shape[0]
 
-    # Per-fold preprocessing matches a standalone fit on the fold's training
-    # rows exactly: bin edges (and, for regression, the log-target decision)
-    # come from the training rows only; all rows are then transformed with
-    # the fold's edges so held-out predictions fall out of the same program.
     fold_bins, fold_y, fold_log = [], [], []
     for fold in folds:
         train_mask = np.ones(n, dtype=bool)
@@ -634,17 +618,7 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
             fold_y.append(template._pad(yv_f, mesh=mesh, train=True))
             fold_log.append(log_f)
 
-    # Configs sharing (depth, round cap) advance together; configs that
-    # differ in those STATIC dims form separate groups, each chunk still a
-    # single launch — every config is trained with its own true
-    # hyperparameters.
-    groups: Dict[Tuple[int, int], List[int]] = {}
-    for ci, cfg in enumerate(configs):
-        groups.setdefault((cfg_depth(cfg), cfg_rounds(cfg)), []).append(ci)
-
-    # Per-fold tensors (weights, base scores, validation masks, device
-    # placement) are group-independent: prepare and place them once.
-    fold_prep = []
+    instances = []
     for fi, fold in enumerate(folds):
         train_mask = np.ones(n, dtype=bool)
         train_mask[fold] = False
@@ -667,12 +641,83 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
 
         val = np.zeros(n_pad, np.float32)
         val[fold] = 1.0
-        fold_prep.append((fi, fold, fold_bins[fi], fold_y[fi],
-                          template._pad(w, mesh=mesh, train=True), val,
-                          base))
+        instances.append(dict(
+            bins=fold_bins[fi], y=fold_y[fi],
+            w=template._pad(w, mesh=mesh, train=True), val=val, base=base,
+            log=1.0 if fold_log[fi] else 0.0))
 
-    if not fold_prep:
-        return 0, -np.inf, 0
+    if not instances:
+        return None
+    return dict(
+        objective=objective, k=k, k_real=k_real, n=n, n_pad=n_pad,
+        d_pad=int(instances[0]["bins"].shape[1]),
+        n_bins=template.max_bin + 1, y_cmp=template._pad(
+            y_cmp, mesh=mesh, train=True),
+        scale=scale, cw_corr=cw_corr, class_valid=class_valid,
+        template=template, is_discrete=is_discrete, instances=instances)
+
+
+def _cfg_rounds_for(cfg: dict, objective: str, k: int) -> int:
+    r = min(int(cfg.get("n_estimators", 200)), 200)
+    if objective == "multiclass":
+        r = min(r, max(40, 400 // k))
+    return r
+
+
+# Instance-axis width per CV launch: bounds both device memory (the TPU
+# histogram path materializes a [W, n, d*n_bins] one-hot) and the number of
+# compiled slab-width variants (tails pad to powers of two).
+_CV_INSTANCE_CAP = 16
+
+
+def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
+                              configs: List[dict], timeout_s: float = 0.0,
+                              good_enough: float = _GOOD_ENOUGH_F1) \
+        -> List[Tuple[int, float, int, bool]]:
+    """Chunked early-stopping K-fold CV grid search over MANY targets in
+    shared device launches — the batched replacement for the reference's
+    per-attribute pandas-UDF training fan-out (reference model.py:817-926):
+    every (target, fold) pair whose static shape matches ((depth, rounds)
+    config group, padded rows/features, objective, class bucket) stacks
+    into ONE vmapped launch per boosting chunk, so N per-attribute searches
+    cost a few device-saturating programs instead of N small sequential
+    ones.
+
+    Per-target bookkeeping reproduces the single-target semantics exactly:
+    classifiers rank by their best checkpoint with 2-chunk patience,
+    regressors by the latest horizon; a perfect or good-enough macro-F1
+    retires the target from ALL remaining groups. A retired target's
+    instances keep advancing inside already-stacked launches (they cannot
+    leave a compiled program), but their stats are frozen — results match
+    the sequential path.
+
+    Returns one (best config index, mean CV score, best round count,
+    timed_out) tuple per prep; a None prep yields (0, -inf, 0, False).
+    ``timed_out`` distinguishes a deadline-truncated search from a genuine
+    early stop, so callers only shrink the final fit's round budget when
+    the round count was actually CV-proven."""
+    import os
+    import time
+    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+
+    T = len(preps)
+    best_by_cfg: List[Dict[int, Tuple[float, int]]] = [{} for _ in range(T)]
+    done = [p is None for p in preps]
+    timed_out = False
+    # timed is PER TARGET: a target retired (done) or fully searched before
+    # the deadline keeps its CV-proven round count even when another
+    # target's group later trips the deadline
+    timed = [False] * T
+    patience_chunks = 2
+    eps = 1e-12
+    # static per-instance tensors are identical across (depth, rounds)
+    # config groups — place them once per distinct instance set, not once
+    # per group (the single-target search alone has 2-3 groups per call)
+    slab_static_cache: Dict[Tuple, Any] = {}
+    mesh_static_cache: Dict[Tuple[int, int], Any] = {}
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -686,145 +731,263 @@ def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
                 lambda idx: np.ascontiguousarray(np.asarray(arr)[idx]))
         return jax.device_put(np.asarray(arr), sharding)
 
-    y_cmp_dev = place(template._pad(y_cmp, mesh=mesh, train=True), P("dp"))
-    cw_dev = jnp.asarray(cw_corr)
-    valid_dev = jnp.asarray(class_valid)
+    # Work units: a (depth, rounds) config group fused with the static
+    # tensor dims; targets sharing a key share its launches. Insertion
+    # order preserves each target's sequential group order.
+    merged: Dict[Tuple, List[int]] = {}
+    for t, prep in enumerate(preps):
+        if prep is None:
+            continue
+        tgroups: Dict[Tuple[int, int], List[int]] = {}
+        for ci, cfg in enumerate(configs):
+            depth = int(cfg.get("max_depth", prep["template"].max_depth))
+            rounds = _cfg_rounds_for(cfg, prep["objective"], prep["k"])
+            tgroups.setdefault((depth, rounds), []).append(ci)
+        for (depth, rounds), cfg_idx in tgroups.items():
+            key = (depth, rounds, prep["n_pad"], prep["d_pad"],
+                   prep["n_bins"], prep["objective"], prep["k"],
+                   tuple(cfg_idx))
+            merged.setdefault(key, []).append(t)
 
-    if mesh is None:
-        bins_dev = jnp.stack([jnp.asarray(p[2]) for p in fold_prep])
-        ys_dev = jnp.stack([jnp.asarray(p[3]) for p in fold_prep])
-        ws_dev = jnp.stack([jnp.asarray(p[4]) for p in fold_prep])
-        vals_dev = jnp.stack([jnp.asarray(p[5]) for p in fold_prep])
-    else:
-        bins_dev = [place(p[2], P("dp", None)) for p in fold_prep]
-        ys_dev = [place(p[3], P("dp")) for p in fold_prep]
-        ws_dev = [place(p[4], P("dp")) for p in fold_prep]
-        vals_dev = [place(p[5], P("dp")) for p in fold_prep]
-    logs_np = np.asarray(
-        [1.0 if fold_log[p[0]] else 0.0 for p in fold_prep], np.float32)
+    remaining_groups = [0] * T
+    for t_members in merged.values():
+        for t in t_members:
+            remaining_groups[t] += 1
 
-    # best (score, rounds) per config; rounds = smallest checkpoint at the
-    # config's best score (strict-improvement updates keep it minimal)
-    best_by_cfg: Dict[int, Tuple[float, int]] = {}
-    timed_out = False
-    stop_all = False
-    patience_chunks = 2
-    eps = 1e-12
-    F_spec_m = P(None, "dp", None) if objective == "multiclass" \
-        else P(None, "dp")
-
-    for (g_depth, g_rounds), cfg_indices in groups.items():
-        if timed_out or stop_all:
+    for key, t_members in merged.items():
+        if timed_out:
             break
+        (g_depth, g_rounds, n_pad, d_pad, n_bins, objective, k,
+         cfg_tuple) = key
+        members = [t for t in t_members if not done[t]]
+        if not members:
+            continue
+        cfg_indices = list(cfg_tuple)
         n_cfg = len(cfg_indices)
+        is_discrete = preps[members[0]]["is_discrete"]
+        tmpl = preps[members[0]]["template"]
         lrs = jnp.asarray([configs[ci].get("learning_rate", 0.1)
                            for ci in cfg_indices], jnp.float32)
         regs = jnp.asarray([configs[ci].get("reg_lambda", 1.0)
                             for ci in cfg_indices], jnp.float32)
-        msgs = jnp.asarray([template.min_split_gain] * n_cfg, jnp.float32)
+        msgs = jnp.asarray([tmpl.min_split_gain] * n_cfg, jnp.float32)
         mcws = jnp.asarray([configs[ci].get("min_child_weight", 1.0)
                             for ci in cfg_indices], jnp.float32)
 
-        # margin carries, one per (fold, config) instance
-        if mesh is None:
-            F = jnp.stack([
-                jnp.broadcast_to(
-                    jnp.asarray(_init_margin(p[6], n_pad, objective, k)),
-                    (n_cfg,) + ((n_pad, k) if objective == "multiclass"
-                                else (n_pad,)))
-                for p in fold_prep])
+        inst = [(t, j) for t in members
+                for j in range(len(preps[t]["instances"]))]
+        F_shape = (n_pad, k) if objective == "multiclass" else (n_pad,)
+
+        def init_F(t, j):
+            e = preps[t]["instances"][j]
+            return np.broadcast_to(
+                _init_margin(e["base"], n_pad, objective, k),
+                (n_cfg,) + F_shape).copy()
+
+        if mesh is not None:
+            # rows shard over dp: instances launch one by one, like the
+            # sequential mesh path; static tensors place once per instance
+            # across all config groups
+            F_spec_m = P(None, "dp", None) if objective == "multiclass" \
+                else P(None, "dp")
+            dev = []
+            for (t, j) in inst:
+                if (t, j) not in mesh_static_cache:
+                    p, e = preps[t], preps[t]["instances"][j]
+                    mesh_static_cache[(t, j)] = [
+                        place(e["bins"], P("dp", None)),
+                        place(e["y"], P("dp")), place(e["w"], P("dp")),
+                        place(e["val"], P("dp")), place(p["y_cmp"], P("dp")),
+                        jnp.float32(e["log"]),
+                        jnp.float32(1.0 / p["scale"]),
+                        jnp.asarray(p["cw_corr"]),
+                        jnp.asarray(p["class_valid"])]
+                dev.append(mesh_static_cache[(t, j)]
+                           + [place(init_F(t, j), F_spec_m)])
+            slabs = None
         else:
-            F = [place(np.broadcast_to(
-                    _init_margin(p[6], n_pad, objective, k),
-                    (n_cfg,) + ((n_pad, k) if objective == "multiclass"
-                                else (n_pad,))).copy(), F_spec_m)
-                 for p in fold_prep]
+            cap = max(1, int(os.environ.get("DELPHI_CV_INSTANCE_CAP",
+                                            str(_CV_INSTANCE_CAP))))
+            slabs = [inst[i:i + cap] for i in range(0, len(inst), cap)]
+
+            def stack_pad(arrs, W, fill, dtype=None):
+                out = np.stack([np.asarray(a) for a in arrs])
+                if dtype is not None:
+                    out = out.astype(dtype)
+                if out.shape[0] < W:
+                    pad = np.full((W - out.shape[0],) + out.shape[1:], fill,
+                                  out.dtype)
+                    out = np.concatenate([out, pad])
+                return jnp.asarray(out)
+
+            slab_data = []
+            for slab in slabs:
+                # pad the instance axis to a power of two: few compiled
+                # width variants, and dummy rows (all-zero weights) are
+                # cheap relative to a fresh compile
+                W = 1 << max(0, len(slab) - 1).bit_length()
+                skey = tuple(slab)
+                if skey not in slab_static_cache:
+                    es = [preps[t]["instances"][j] for (t, j) in slab]
+                    ps = [preps[t] for (t, j) in slab]
+                    slab_static_cache[skey] = dict(
+                        bins=stack_pad([e["bins"] for e in es], W, 0),
+                        y=stack_pad([e["y"] for e in es], W, 0),
+                        w=stack_pad([e["w"] for e in es], W, 0),
+                        val=stack_pad([e["val"] for e in es], W, 0),
+                        ycmp=stack_pad([p["y_cmp"] for p in ps], W, 0),
+                        log=stack_pad(
+                            [np.float32(e["log"]) for e in es], W, 0),
+                        iscale=stack_pad(
+                            [np.float32(1.0 / p["scale"]) for p in ps],
+                            W, 1),
+                        cw=stack_pad([p["cw_corr"] for p in ps], W, 1),
+                        valid=stack_pad(
+                            [p["class_valid"] for p in ps], W, 1))
+                slab_data.append(dict(
+                    slab_static_cache[skey], n=len(slab),
+                    F=stack_pad([init_F(t, j) for (t, j) in slab], W, 0)))
 
         rounds_done = 0
-        no_improve = 0
+        active = {t: True for t in members}
+        no_improve = {t: 0 for t in members}
+        stats_buf: List[Any] = [None] * len(inst)
         for chunk in _round_chunks(g_rounds):
             if deadline is not None and time.monotonic() > deadline:
                 timed_out = True
                 break
+            if not any(active[t] and not done[t] for t in members):
+                break
             fn = _cv_chunk_fn(mesh, chunk, g_depth, n_bins, 1 << g_depth,
                               objective, k)
-            if mesh is None:
-                # one launch advances every (fold, config) instance
-                F, stats = fn(bins_dev, ys_dev, ws_dev, vals_dev, y_cmp_dev,
-                              jnp.asarray(logs_np), cw_dev, valid_dev, F,
-                              lrs, regs, msgs, mcws)
-                stats_np = np.asarray(jax.device_get(stats))
+            if mesh is not None:
+                # per-instance launches: retired targets' instances simply
+                # skip (their stats are frozen and never read again)
+                rows = []
+                for i, dvi in enumerate(dev):
+                    t = inst[i][0]
+                    if done[t] or not active[t]:
+                        rows.append(stats_buf[i])
+                        continue
+                    dvi[9], s = fn(*dvi, lrs, regs, msgs, mcws)
+                    rows.append(np.asarray(jax.device_get(s)))
+                stats_buf = rows
+                stats_np = np.stack(rows)
             else:
-                stats_parts = []
-                for i in range(len(fold_prep)):
-                    F[i], s = fn(bins_dev[i], ys_dev[i], ws_dev[i],
-                                 vals_dev[i], y_cmp_dev,
-                                 jnp.float32(logs_np[i]), cw_dev, valid_dev,
-                                 F[i], lrs, regs, msgs, mcws)
-                    stats_parts.append(np.asarray(jax.device_get(s)))
-                stats_np = np.stack(stats_parts)  # [n_folds, n_cfg, ...]
+                parts = []
+                for sd in slab_data:
+                    sd["F"], s = fn(sd["bins"], sd["y"], sd["w"], sd["val"],
+                                    sd["ycmp"], sd["log"], sd["iscale"],
+                                    sd["cw"], sd["valid"], sd["F"],
+                                    lrs, regs, msgs, mcws)
+                    parts.append(np.asarray(jax.device_get(s))[:sd["n"]])
+                stats_np = np.concatenate(parts, axis=0)
             rounds_done += chunk
 
-            improved = False
-            for j, ci in enumerate(cfg_indices):
-                fold_scores = []
-                for i in range(len(fold_prep)):
-                    s = stats_np[i, j]
+            for t in members:
+                if done[t] or not active[t]:
+                    continue
+                prep = preps[t]
+                rows_t = [i for i, (tt, _) in enumerate(inst) if tt == t]
+                improved = False
+                for jj, ci in enumerate(cfg_indices):
+                    fold_scores = []
+                    for i in rows_t:
+                        s = stats_np[i, jj]
+                        if is_discrete:
+                            fold_scores.append(
+                                _f1_from_confusion(s, prep["k_real"]))
+                        else:
+                            # rescale the normalized SSE back in float64
+                            sse = float(s[0]) * prep["scale"] ** 2
+                            fold_scores.append(-sse / max(float(s[1]), 1.0))
+                    mean = float(np.mean(fold_scores))
                     if is_discrete:
-                        fold_scores.append(_f1_from_confusion(s, k_real))
+                        # classifiers rank by their best checkpoint, and
+                        # the recorded round count sizes the final fit
+                        if mean > best_by_cfg[t].get(ci, (-np.inf, 0))[0] + eps:
+                            best_by_cfg[t][ci] = (mean, rounds_done)
+                            improved = True
                     else:
-                        fold_scores.append(-float(s[0] / max(s[1], 1.0)))
-                mean = float(np.mean(fold_scores))
-                if is_discrete:
-                    # classifiers rank by their best checkpoint, and the
-                    # recorded round count sizes the final fit
-                    if mean > best_by_cfg.get(ci, (-np.inf, 0))[0] + eps:
-                        best_by_cfg[ci] = (mean, rounds_done)
-                        improved = True
-                else:
-                    # regressors rank by the LATEST horizon: their final
-                    # fit trains the full round budget, so selection must
-                    # score the behavior that will actually deploy (MSE
-                    # keeps creeping down with rounds; a lucky early
-                    # checkpoint must not pick the config). Patience below
-                    # is classifier-only, so no improvement flag needed.
-                    best_by_cfg[ci] = (mean, rounds_done)
-                # Early exit on a PERFECT classifier score: a config at
-                # macro-F1 1.0 on every fold cannot be beaten — remaining
-                # chunks AND groups are pure cost (on easy targets like
-                # hospital State this halves the search).
-                if is_discrete and min(fold_scores) >= 1.0 - 1e-12:
-                    stop_all = True
-            if stop_all:
-                break
-            # Good-enough stop WITHIN the group too: further chunks are
-            # cost in both the search and the final fit they size.
-            if is_discrete and any(
-                    best_by_cfg.get(ci, (-np.inf, 0))[0] >= _GOOD_ENOUGH_F1
-                    for ci in cfg_indices):
-                break
-            if improved:
-                no_improve = 0
-            elif is_discrete:
-                # patience applies to classifiers only: their final fit
-                # trains the best checkpoint's rounds, so stopping early is
-                # consistent. Regressors deploy at the full round budget and
-                # rank by the latest horizon, so their search must reach it.
-                no_improve += 1
-                if no_improve >= patience_chunks:
-                    break
+                        # regressors rank by the LATEST horizon: their
+                        # final fit trains the full round budget, so
+                        # selection must score the behavior that deploys
+                        best_by_cfg[t][ci] = (mean, rounds_done)
+                    # a PERFECT classifier score cannot be beaten: retire
+                    # the target from every remaining chunk and group
+                    if is_discrete and fold_scores \
+                            and min(fold_scores) >= 1.0 - 1e-12:
+                        done[t] = True
+                if done[t]:
+                    continue
+                # good-enough stop: later chunks AND groups cannot pay for
+                # themselves for this target
+                if is_discrete and any(
+                        best_by_cfg[t].get(ci, (-np.inf, 0))[0] >= good_enough
+                        for ci in cfg_indices):
+                    done[t] = True
+                    continue
+                if improved:
+                    no_improve[t] = 0
+                elif is_discrete:
+                    # patience applies to classifiers only: regressors
+                    # deploy at the full round budget and must reach it
+                    no_improve[t] += 1
+                    if no_improve[t] >= patience_chunks:
+                        active[t] = False
 
-        # Good-enough group stop: later shape groups' launches cannot pay
-        # for themselves either.
-        if is_discrete and best_by_cfg and \
-                max(s for s, _ in best_by_cfg.values()) >= _GOOD_ENOUGH_F1:
-            break
+        if timed_out:
+            # the deadline hit mid-group: only the targets still searching
+            # lose their CV-proven round counts
+            for t in members:
+                if not done[t]:
+                    timed[t] = True
+        else:
+            for t in t_members:
+                remaining_groups[t] -= 1
 
-    if not best_by_cfg:
-        return 0, -np.inf, 0
-    best_ci = max(best_by_cfg, key=lambda ci: best_by_cfg[ci][0])
-    best_score, best_rounds = best_by_cfg[best_ci]
-    return best_ci, best_score, best_rounds
+    if timed_out:
+        # groups the deadline prevented from ever running
+        for t in range(T):
+            if remaining_groups[t] > 0 and not done[t]:
+                timed[t] = True
+
+    out: List[Tuple[int, float, int, bool]] = []
+    for t in range(T):
+        if not best_by_cfg[t]:
+            out.append((0, -np.inf, 0, timed[t] or
+                        (timed_out and preps[t] is not None and not done[t])))
+            continue
+        best_ci = max(best_by_cfg[t], key=lambda ci: best_by_cfg[t][ci][0])
+        best_score, best_rounds = best_by_cfg[t][best_ci]
+        out.append((best_ci, best_score, best_rounds, timed[t]))
+    return out
+
+
+def gbdt_cv_grid_search(X: np.ndarray, y: Any, is_discrete: bool,
+                        configs: List[dict], n_splits: int,
+                        class_weight: str,
+                        template: "GradientBoostedTreesModel",
+                        timeout_s: float = 0.0,
+                        good_enough: float = _GOOD_ENOUGH_F1) \
+        -> Tuple[int, float, int, bool]:
+    """Single-target K-fold CV grid search: a one-element call into
+    :func:`gbdt_cv_grid_search_multi` (folds still stack into one vmapped
+    launch per config shape group, with chunked early stopping —
+    LightGBM's ``early_stopping_rounds`` semantics, reference
+    train.py:193-200, at ``_CHUNK_ROUNDS`` granularity).
+
+    Returns (best config index, mean CV score, best round count,
+    timed_out); the round count is the SMALLEST checkpoint where the
+    winning config reached its best score. Scores keep the reference's
+    hyperopt metrics (train.py:158): macro-F1 for classifiers, -MSE for
+    regressors. ``timeout_s`` > 0 bounds the search like the reference's
+    hyperopt timeout (train.py:196)."""
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    prep = _cv_prepare_target(X, y, is_discrete, n_splits, class_weight,
+                              template, get_active_mesh())
+    return gbdt_cv_grid_search_multi(
+        [prep], configs, timeout_s=timeout_s, good_enough=good_enough)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -896,9 +1059,14 @@ class GradientBoostedTreesModel:
         return np.concatenate(
             [bins, np.zeros((bins.shape[0], target - d), bins.dtype)], axis=1)
 
-    def fit(self, X: Any, y: Any) -> "GradientBoostedTreesModel":
-        from delphi_tpu.parallel.mesh import get_active_mesh
-        mesh = get_active_mesh()
+    def _fit_prepare(self, X: Any, y: Any, mesh: Any) \
+            -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, float]:
+        """Everything in a fit that happens BEFORE boosting: binning, label
+        factorization, class weights, base margins, padding. Returns
+        (bins, y, w, F0, min_child_samples) as padded host arrays and sets
+        the model's inference state, so the batched multi-target fit path
+        can prepare each model and run the boosting chunks of a whole shape
+        group in shared vmapped launches."""
         Xm = self._as_matrix(X)
         n, d = Xm.shape
         self._binner = _Binner(self.max_bin).fit(Xm)
@@ -970,12 +1138,38 @@ class GradientBoostedTreesModel:
         # against upweighted rare typo classes, and a hard floor costs
         # accuracy on tight local structure (e.g. boston RAD).
         mcs = self.min_child_samples if self.is_discrete else 0.0
+        F0 = _init_margin(base, bins_np.shape[0], self._objective,
+                          max(self._k, 1))
+        return bins_np, yv_p, w_p, F0, mcs
+
+    def _set_trees(self, parts: List[Any], n_rounds: Optional[int] = None) \
+            -> None:
+        """Installs the boosted trees from per-chunk (feat, thr, leaf)
+        stacks, optionally truncated to ``n_rounds``: boosting is
+        prefix-deterministic (round r never depends on later rounds), so a
+        longer run truncated to r rounds IS the r-round model — the batched
+        fit trains a whole shape group to its max budget and each model
+        keeps its own prefix."""
+        parts = [jax.device_get(t) for t in parts]
+        trees = tuple(
+            np.concatenate([p[i] for p in parts], axis=0) for i in range(3))
+        if n_rounds is not None and trees[0].shape[0] > n_rounds:
+            trees = tuple(t[:n_rounds] for t in trees)
+        self.n_estimators = int(trees[0].shape[0])
+        self._trees = trees
+
+    def fit(self, X: Any, y: Any) -> "GradientBoostedTreesModel":
+        from delphi_tpu.parallel.mesh import get_active_mesh
+        mesh = get_active_mesh()
+        bins_np, yv_p, w_p, F, mcs = self._fit_prepare(X, y, mesh)
+        return self._fit_boost_prepared(mesh, bins_np, yv_p, w_p, F, mcs)
+
+    def _fit_boost_prepared(self, mesh, bins_np, yv_p, w_p, F, mcs) \
+            -> "GradientBoostedTreesModel":
         # Chunked fit: the margin carry stays on device between fixed-size
         # chunk launches, so any CV-selected round count (the early-stopping
         # driver below) reuses the SAME compiled chunk program instead of
         # compiling one scan per distinct n_estimators.
-        F = _init_margin(base, bins_np.shape[0], self._objective,
-                         max(self._k, 1))
         parts: List[Any] = []
         if mesh is not None:
             from delphi_tpu.parallel.mesh import shard_rows
@@ -1005,9 +1199,7 @@ class GradientBoostedTreesModel:
                     self.min_split_gain, self.min_child_weight, mcs,
                     use_counts=mcs > 0)
                 parts.append(trees)
-        parts = [jax.device_get(t) for t in parts]
-        self._trees = tuple(
-            np.concatenate([p[i] for p in parts], axis=0) for i in range(3))
+        self._set_trees(parts)
         return self
 
     def _raw_scores(self, X: Any) -> np.ndarray:
@@ -1064,3 +1256,88 @@ class GradientBoostedTreesModel:
         if getattr(self, "_log_target", False):
             pred = np.expm1(pred)
         return pred
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-target final fits
+# ---------------------------------------------------------------------------
+
+# Model-axis width per batched fit launch: bounds the TPU histogram path's
+# [M, n, d*n_bins] one-hot materialization.
+_FIT_BATCH_CAP = 8
+
+
+@partial(jax.jit, static_argnames=("n_rounds", "depth", "n_bins", "n_nodes",
+                                   "objective", "k", "use_counts"))
+def _boost_batch(bins, y, w, F0, lrs, regs, msgs, mcws, mcss, n_rounds,
+                 depth, n_bins, n_nodes, objective, k, use_counts):
+    """One boosting chunk for a stacked batch of models (the final-fit side
+    of the reference's per-attribute training fan-out, model.py:817-926):
+    vmap over the model axis with per-model dynamic hyperparameters, so a
+    whole shape group of per-attribute fits advances in one launch."""
+    def one(b, yy, ww, f0, lr, rg, ms, mcw, mcs):
+        return _boost(b, yy, ww, f0, n_rounds, depth, n_bins, n_nodes,
+                      objective, k, lr, rg, ms, mcw, mcs,
+                      use_counts=use_counts)
+
+    return jax.vmap(one)(bins, y, w, F0, lrs, regs, msgs, mcws, mcss)
+
+
+def gbdt_fit_batch(entries: List[Tuple["GradientBoostedTreesModel",
+                                       Any, Any]]) -> None:
+    """Fits many GBDT models in shared vmapped launches: models are
+    prepared individually (binning, class weights — host work), grouped by
+    their static compile dims (depth, bins/nodes, objective, class bucket,
+    padded tensor shape, counts channel), and each group boosts to its MAX
+    round budget in `_FIT_BATCH_CAP`-wide chunked launches; every model
+    then keeps its own round-count prefix of the stacked trees (boosting is
+    prefix-deterministic, see `_set_trees`). Under a mesh the models fit
+    one at a time with rows sharded over dp — there the mesh is the
+    batching axis. Singleton groups take the plain chunked fit."""
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    mesh = get_active_mesh()
+    if mesh is not None or len(entries) <= 1:
+        for m, X, y in entries:
+            m.fit(X, y)
+        return
+
+    prepped = []
+    for m, X, y in entries:
+        prepped.append((m,) + m._fit_prepare(X, y, None))
+
+    groups: Dict[Tuple, List[int]] = {}
+    for i, (m, bins_np, yv_p, w_p, F0, mcs) in enumerate(prepped):
+        key = (m.max_depth, m._n_bins, m._n_nodes, m._objective,
+               max(m._k, 1), bins_np.shape, bool(mcs > 0))
+        groups.setdefault(key, []).append(i)
+
+    for key, idxs in groups.items():
+        depth, n_bins, n_nodes, objective, k, _shape, use_counts = key
+        if len(idxs) == 1:
+            m, bins_np, yv_p, w_p, F0, mcs = prepped[idxs[0]]
+            m._fit_boost_prepared(None, bins_np, yv_p, w_p, F0, mcs)
+            continue
+        for s in range(0, len(idxs), _FIT_BATCH_CAP):
+            sub = idxs[s:s + _FIT_BATCH_CAP]
+            models = [prepped[i][0] for i in sub]
+            rounds_max = max(m.n_estimators for m in models)
+            bins = jnp.asarray(np.stack([prepped[i][1] for i in sub]))
+            ys = jnp.asarray(np.stack([prepped[i][2] for i in sub]))
+            ws = jnp.asarray(np.stack([prepped[i][3] for i in sub]))
+            F = jnp.asarray(np.stack([prepped[i][4] for i in sub]))
+            lrs = jnp.asarray([m.learning_rate for m in models], jnp.float32)
+            regs = jnp.asarray([m.reg_lambda for m in models], jnp.float32)
+            msgs = jnp.asarray([m.min_split_gain for m in models],
+                               jnp.float32)
+            mcws = jnp.asarray([m.min_child_weight for m in models],
+                               jnp.float32)
+            mcss = jnp.asarray([prepped[i][5] for i in sub], jnp.float32)
+            parts = []
+            for chunk in _round_chunks(rounds_max):
+                F, trees = _boost_batch(
+                    bins, ys, ws, F, lrs, regs, msgs, mcws, mcss, chunk,
+                    depth, n_bins, n_nodes, objective, k, use_counts)
+                parts.append(jax.device_get(trees))
+            for mi, m in enumerate(models):
+                own = [tuple(np.asarray(t)[mi] for t in p) for p in parts]
+                m._set_trees(own, n_rounds=m.n_estimators)

@@ -58,6 +58,9 @@ _opt_max_evals = \
 _opt_no_progress_loss = \
     _option("model.hp.no_progress_loss", 50, int,
             lambda v: v > 0, "`{}` should be positive")
+_opt_stop_score = \
+    _option("model.hp.stop_score", 0.995, float,
+            lambda v: 0.0 < v <= 1.0, "`{}` should be in (0.0, 1.0]")
 
 train_option_keys = [
     _opt_boosting_type.key,
@@ -73,6 +76,7 @@ train_option_keys = [
     _opt_timeout.key,
     _opt_max_evals.key,
     _opt_no_progress_loss.key,
+    _opt_stop_score.key,
 ]
 
 
@@ -168,7 +172,7 @@ def _refine_candidates(cfg: dict, seen: list, scale: int = 1) -> list:
 
 def _refine_best_config(X, y, is_discrete, best_cfg, best_score, best_rounds,
                         grid, n_splits, class_weight, template, deadline,
-                        no_progress_evals, explicit):
+                        no_progress_evals, explicit, good_enough=0.995):
     """Adaptive second phase of the hyperparameter search, honoring
     `model.hp.no_progress_loss` (the reference's hyperopt early-stop,
     train.py:196): rounds of local refinement around the current best config
@@ -201,9 +205,10 @@ def _refine_best_config(X, y, is_discrete, best_cfg, best_score, best_rounds,
         if not candidates:
             break
         seen.extend(candidates)
-        ci, score, rounds = gbdt_cv_grid_search(
+        ci, score, rounds, r_timed = gbdt_cv_grid_search(
             X, y, is_discrete, candidates, n_splits, class_weight, template,
-            timeout_s=remaining if remaining is not None else 0.0)
+            timeout_s=remaining if remaining is not None else 0.0,
+            good_enough=good_enough)
         if score <= best_score:
             evals_no_progress += len(candidates)
             if evals_no_progress >= no_progress_evals or scale >= 3:
@@ -215,6 +220,12 @@ def _refine_best_config(X, y, is_discrete, best_cfg, best_score, best_rounds,
         _logger.info(
             f"Refinement improved CV score {best_score:.4f} -> {score:.4f} "
             f"({candidates[ci]})")
+        if r_timed and rounds > 0:
+            # deadline-truncated search: its round count is where the clock
+            # ran out, not a CV-proven early stop — 0 disables the final
+            # fit's round truncation (the reference's hyperopt timeout only
+            # bounds the search, never the final round budget)
+            rounds = 0
         # candidates carry the GRID's round budget (best_cfg is never given
         # the truncated count — a slower-learning candidate must be free to
         # use more rounds than the incumbent's early stop chose); the
@@ -249,34 +260,18 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                         class_weight=class_weight, **cfg)
                 return make
 
-            grid = _GBDT_GRID[: max(1, min(len(_GBDT_GRID), max_evals))]
-            if _opt_max_evals.key not in opts:
-                import jax
-                if jax.default_backend() == "cpu":
-                    # Platform-aware search depth: on an accelerator the
-                    # extra configs ride the same vmapped launches almost
-                    # free, but on a CPU host every config costs real
-                    # sequential FLOPs. Classifiers trim to the strongest
-                    # config per tree depth — their searches also early-exit
-                    # on perfect/near-perfect CV F1, and the hospital /
-                    # flights / adult gates hold at this width. Regressors
-                    # keep 4: RMSE gates (boston CRIM+RAD) are sensitive to
-                    # the reg_lambda/min_child_weight axis the 2-config trim
-                    # would drop, and regression targets are the minority.
-                    if is_discrete:
-                        seen_depths = set()
-                        trimmed = []
-                        for cfg in grid[:4]:
-                            depth = cfg.get("max_depth", 7)
-                            if depth not in seen_depths:
-                                seen_depths.add(depth)
-                                trimmed.append(cfg)
-                        grid = trimmed
-                    else:
-                        grid = grid[:4]
-            if is_discrete and num_class > 8:
-                # wide multiclass: CV grid search is too costly for the gain
-                grid = grid[:1]
+            # Platform-aware search depth (_trimmed_grid): on an accelerator
+            # the extra configs ride the same vmapped launches almost free,
+            # but on a CPU host every config costs real sequential FLOPs.
+            # Classifiers trim to the strongest config per tree depth —
+            # their searches also early-exit on perfect/near-perfect CV F1,
+            # and the hospital / flights / adult gates hold at this width.
+            # Regressors keep 4: RMSE gates (boston CRIM+RAD) are sensitive
+            # to the reg_lambda/min_child_weight axis the 2-config trim
+            # would drop, and regression targets are the minority.
+            import jax
+            grid = _trimmed_grid(is_discrete, num_class, max_evals, opts,
+                                 jax.default_backend() == "cpu")
             best_cfg, best_score = grid[0], -np.inf
             if len(grid) > 1 and len(X) >= n_splits * 2:
                 # every (config, fold) instance trains in ONE vmapped XLA
@@ -288,15 +283,23 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                 # refinement), like the reference's hyperopt timeout
                 deadline = time.monotonic() + timeout_s if timeout_s > 0 \
                     else None
-                best_ci, best_score, best_rounds = gbdt_cv_grid_search(
-                    X, y, is_discrete, grid, n_splits, class_weight, template,
-                    timeout_s=timeout_s)
+                good_enough = float(opt(*_opt_stop_score))
+                best_ci, best_score, best_rounds, timed0 = \
+                    gbdt_cv_grid_search(
+                        X, y, is_discrete, grid, n_splits, class_weight,
+                        template, timeout_s=timeout_s,
+                        good_enough=good_enough)
+                if timed0:
+                    # a deadline-truncated search must not shrink the final
+                    # fit's round budget (see _refine_best_config)
+                    best_rounds = 0
                 best_cfg = dict(grid[best_ci])
                 best_cfg, best_score, best_rounds = _refine_best_config(
                     X, y, is_discrete, best_cfg, best_score, best_rounds,
                     grid, n_splits, class_weight, template, deadline,
                     no_progress_evals=int(opt(*_opt_no_progress_loss)),
-                    explicit=_opt_no_progress_loss.key in opts)
+                    explicit=_opt_no_progress_loss.key in opts,
+                    good_enough=good_enough)
                 if best_rounds > 0 and is_discrete:
                     # the final fit trains only as many rounds as CV proved
                     # useful for the WINNING config (LightGBM
@@ -332,6 +335,192 @@ def build_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: int,
     """Returns ((model, score), elapsed_seconds); model is None on failure
     (callers substitute PoorModel, reference train.py:227-229)."""
     return _build_jax_model(X, y, is_discrete, num_class, n_jobs, opts)
+
+
+def _trimmed_grid(is_discrete: bool, num_class: int, max_evals: int,
+                  opts: Dict[str, str], cpu: bool) -> list:
+    """The per-target candidate grid `_build_jax_model` would search —
+    platform-aware trimming included — factored out so the batched path
+    selects identical grids."""
+    grid = _GBDT_GRID[: max(1, min(len(_GBDT_GRID), max_evals))]
+    if _opt_max_evals.key not in opts and cpu:
+        if is_discrete:
+            seen_depths: set = set()
+            trimmed = []
+            for cfg in grid[:4]:
+                depth = cfg.get("max_depth", 7)
+                if depth not in seen_depths:
+                    seen_depths.add(depth)
+                    trimmed.append(cfg)
+            grid = trimmed
+        else:
+            grid = grid[:4]
+    if is_discrete and num_class > 8:
+        # wide multiclass: CV grid search is too costly for the gain
+        grid = grid[:1]
+    return grid
+
+
+def build_models_batched(tasks: list, opts: Dict[str, str]) \
+        -> Dict[str, Tuple[Tuple[Any, float], float]]:
+    """Builds MANY per-attribute repair models with batched device work —
+    the TPU-native replacement for the reference's parallel pandas-UDF
+    training fan-out (reference model.py:817-926): instead of distributing
+    N per-attribute fits over executors, their CV searches stack into
+    shared vmapped launches (`gbdt_cv_grid_search_multi`) and their final
+    fits advance in shape-grouped batched boosting chunks
+    (`gbdt_fit_batch`), so phase 2 issues a handful of device-saturating
+    programs instead of N sequential small ones.
+
+    ``tasks``: list of (name, X, y, is_discrete, num_class). Returns
+    {name: ((model, score), elapsed_s)}; model None on failure, like
+    :func:`build_model`. Non-GBDT targets (wide multiclass -> logistic
+    head, linear designs) train per-target via :func:`build_model` —
+    their fits are single jitted launches already."""
+    import time
+
+    t0 = time.time()
+    results: Dict[str, Tuple[Tuple[Any, float], float]] = {}
+    gbdt_tasks = []
+    try:
+        from delphi_tpu.models.encoding import OneHotDesign
+        from delphi_tpu.models.gbdt import (
+            GradientBoostedTreesModel, _cv_prepare_target,
+            gbdt_cv_grid_search_multi, gbdt_fit_batch, gbdt_supported)
+        for task in tasks:
+            name, X, y, is_discrete, num_class = task
+            if gbdt_supported(is_discrete, num_class) \
+                    and not isinstance(X, OneHotDesign):
+                gbdt_tasks.append(task)
+            else:
+                results[name] = build_model(
+                    X, y, is_discrete, num_class, -1, opts)
+        if not gbdt_tasks:
+            return results
+
+        def opt(*args):  # type: ignore
+            return get_option_value(opts, *args)
+
+        n_splits = int(opt(*_opt_n_splits))
+        max_evals = int(opt(*_opt_max_evals))
+        class_weight = str(opt(*_opt_class_weight))
+        good_enough = float(opt(*_opt_stop_score))
+        timeout_s = float(opt(*_opt_timeout))
+        # model.hp.timeout is a PER-TARGET budget (each sequential search
+        # gets its own window, reference train.py:196); the batched path
+        # pools the same total so later cv_sets aren't starved by earlier
+        # ones consuming a single per-target window
+        deadline = time.monotonic() + timeout_s * len(gbdt_tasks) \
+            if timeout_s > 0 else None
+
+        import jax
+
+        from delphi_tpu.parallel.mesh import get_active_mesh
+        cpu = jax.default_backend() == "cpu"
+        mesh = get_active_mesh()
+
+        def factory(cfg: dict, is_discrete: bool, num_class: int) \
+                -> GradientBoostedTreesModel:
+            return GradientBoostedTreesModel(
+                is_discrete=is_discrete, num_class=num_class,
+                max_bin=int(opt(*_opt_max_bin)),
+                min_split_gain=float(opt(*_opt_min_split_gain)),
+                class_weight=class_weight, **cfg)
+
+        # tasks sharing a candidate grid share one multi-target CV search;
+        # single-config grids (wide multiclass) skip CV entirely
+        chosen: Dict[int, Tuple[dict, float, int, list]] = {}
+        templates: Dict[int, Any] = {}
+        cv_sets: Dict[tuple, list] = {}
+        for ti, (name, X, y, is_discrete, num_class) in enumerate(gbdt_tasks):
+            grid = _trimmed_grid(is_discrete, num_class, max_evals, opts, cpu)
+            chosen[ti] = (dict(grid[0]), -np.inf, 0, grid)
+            if len(grid) > 1 and len(X) >= n_splits * 2:
+                sig = tuple(tuple(sorted(c.items())) for c in grid)
+                cv_sets.setdefault(sig, []).append(ti)
+
+        for tis in cv_sets.values():
+            grid = chosen[tis[0]][3]
+            preps = []
+            for ti in tis:
+                name, X, y, is_discrete, num_class = gbdt_tasks[ti]
+                tmpl = factory(dict(grid[0]), is_discrete, num_class)
+                templates[ti] = tmpl
+                try:
+                    preps.append(_cv_prepare_target(
+                        X, y, is_discrete, n_splits, class_weight, tmpl,
+                        mesh))
+                except Exception as e:
+                    _logger.warning(f"{e.__class__}: {e}")
+                    preps.append(None)
+            remaining = 0.0 if deadline is None \
+                else max(deadline - time.monotonic(), 1e-3)
+            res = gbdt_cv_grid_search_multi(
+                preps, grid, timeout_s=remaining, good_enough=good_enough)
+            for ti, (ci, score, rounds, timed) in zip(tis, res):
+                if timed:
+                    rounds = 0  # not CV-proven: keep the full round budget
+                chosen[ti] = (dict(grid[ci]), score, rounds, grid)
+
+        # local refinement stays per-target (candidate neighborhoods
+        # diverge), but only for targets the base grid left below the
+        # good-enough bar — the ones refinement can actually help
+        explicit = _opt_no_progress_loss.key in opts
+        for ti in list(templates):
+            name, X, y, is_discrete, num_class = gbdt_tasks[ti]
+            cfg, score, rounds, grid = chosen[ti]
+            if np.isfinite(score) and score < good_enough:
+                cfg, score, rounds = _refine_best_config(
+                    X, y, is_discrete, cfg, score, rounds, grid, n_splits,
+                    class_weight, templates[ti], deadline,
+                    no_progress_evals=int(opt(*_opt_no_progress_loss)),
+                    explicit=explicit, good_enough=good_enough)
+                chosen[ti] = (cfg, score, rounds, grid)
+
+        entries = []
+        finals: Dict[int, Tuple[Any, float]] = {}
+        for ti, (name, X, y, is_discrete, num_class) in enumerate(gbdt_tasks):
+            cfg, score, rounds, grid = chosen[ti]
+            cfg = dict(cfg)
+            if rounds > 0 and is_discrete:
+                # CV-proven early stop sizes the final fit (classifiers
+                # only — see _build_jax_model)
+                cfg["n_estimators"] = rounds
+            m = factory(cfg, is_discrete, num_class)
+            finals[ti] = (m, score)
+            entries.append((m, X, y))
+        try:
+            gbdt_fit_batch(entries)
+        except Exception as e:
+            _logger.warning(
+                f"Batched fit failed ({e.__class__}: {e}); falling back to "
+                "per-target fits")
+            for mi, (m, X, y) in enumerate(entries):
+                try:
+                    m.fit(X, y)
+                except Exception as e2:
+                    _logger.warning(f"{e2.__class__}: {e2}")
+                    finals[mi] = (None, 0.0)
+
+        elapsed_each = (time.time() - t0) / max(len(gbdt_tasks), 1)
+        for ti, (name, X, y, is_discrete, num_class) in enumerate(gbdt_tasks):
+            m, score = finals[ti]
+            score = score if m is not None and np.isfinite(score) \
+                else (-m.loss_ if m is not None else 0.0)
+            results[name] = ((m, score), elapsed_each)
+        return results
+    except Exception as e:
+        # total batched-path failure: every unresolved task falls back to
+        # the sequential builder (never silently drop a target)
+        _logger.warning(
+            f"Batched training failed ({e.__class__}: {e}); falling back "
+            "to sequential per-target training")
+        for task in tasks:
+            name, X, y, is_discrete, num_class = task
+            if name not in results:
+                results[name] = build_model(
+                    X, y, is_discrete, num_class, -1, opts)
+        return results
 
 
 def compute_class_nrow_stdv(y: pd.Series, is_discrete: bool) -> Optional[float]:

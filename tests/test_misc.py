@@ -188,9 +188,10 @@ def test_gbdt_cv_timeout_returns_first_config():
     y = pd.Series((X[:, 0] % 2).astype(str))
     tmpl = GradientBoostedTreesModel(True, 2)
     # an already-expired deadline: no fold launches happen, config 0 wins
-    ci, score, rounds = gbdt_cv_grid_search(
+    ci, score, rounds, timed_out = gbdt_cv_grid_search(
         X, y, True, _GBDT_GRID, 3, "balanced", tmpl, timeout_s=1e-9)
     assert ci == 0 and score == -np.inf and rounds == 0
+    assert timed_out, "an expired deadline must be reported as a timeout"
 
 
 def test_gbdt_grid_platform_default(monkeypatch):
@@ -202,7 +203,7 @@ def test_gbdt_grid_platform_default(monkeypatch):
 
     def fake_search(X, y, is_discrete, configs, *a, **kw):
         captured["grid"] = list(configs)
-        return 0, 1.0, 200
+        return 0, 1.0, 200, False
 
     monkeypatch.setattr(train, "_GBDT_GRID", train._GBDT_GRID)
     import delphi_tpu.models.gbdt as gbdt
@@ -267,7 +268,7 @@ def test_cv_grid_search_returns_early_stopped_rounds():
     X = rng.randint(0, 6, (600, 4)).astype(np.float64)
     y = pd.Series((X[:, 0] % 2).astype(str))  # trivially learnable
     tmpl = GradientBoostedTreesModel(True, 2)
-    ci, score, rounds = gbdt_cv_grid_search(
+    ci, score, rounds, _ = gbdt_cv_grid_search(
         X, y, True, [dict(max_depth=3, learning_rate=0.3, n_estimators=200)],
         3, "balanced", tmpl)
     assert rounds > 0 and rounds % _CHUNK_ROUNDS == 0
