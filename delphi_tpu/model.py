@@ -1435,6 +1435,24 @@ class RepairModel:
         assert len(pmf_df) == len(error_cells_df)
         return pmf_df
 
+    def _finish_candidate_prob(self, pmf_df: pd.DataFrame,
+                               compute_repair_prob: bool) -> pd.DataFrame:
+        """Result shaping for the candidate-probability modes (reference
+        model.py:1204-1225), shared by the whole-block and the chunked
+        at-scale paths."""
+        pmf_df = pmf_df.assign(
+            current_value=[cv["value"] for cv in pmf_df["current_value"]])
+        if compute_repair_prob:
+            return pd.DataFrame({
+                self._row_id: pmf_df[self._row_id],
+                "attribute": pmf_df["attribute"],
+                "current_value": pmf_df["current_value"],
+                "repaired": [p[0]["class"] if p else None
+                             for p in pmf_df["pmf"]],
+                "prob": [p[0]["prob"] if p else None for p in pmf_df["pmf"]],
+            })
+        return pmf_df
+
     def _compute_score(self, pmf_df: pd.DataFrame) -> pd.DataFrame:
         """Log-likelihood-ratio x cost-discount score (reference
         model.py:1227-1248). Vectorized: cost lookups dedupe to one
@@ -1499,10 +1517,63 @@ class RepairModel:
 
     @job_phase(name="validating")
     def _validate_repairs(self, repair_candidates: pd.DataFrame,
+                          repaired_rows: pd.DataFrame,
                           clean_rows: pd.DataFrame) -> pd.DataFrame:
+        """Post-repair constraint validation — implements the check the
+        reference leaves as a TODO (model.py:1279-1285: "statistical models
+        notoriously ignore specified integrity constraints"): the repaired
+        dirty rows re-encode together with the clean context, every
+        ConstraintErrorDetector's denial constraints re-evaluate over the
+        result (the same device kernels phase 1 uses), and candidates whose
+        repaired cell STILL participates in a violation are dropped — the
+        cell stays unrepaired rather than swapping one violation for
+        another."""
         _logger.info("[Validation Phase] Validating {} repair candidates...".format(
             len(repair_candidates)))
-        return repair_candidates
+        detectors = [d for d in self.error_detectors
+                     if isinstance(d, ConstraintErrorDetector)]
+        if not detectors or not len(repair_candidates):
+            return repair_candidates
+
+        from delphi_tpu.ops.detect import detect_constraint_violations
+        from delphi_tpu.table import encode_table
+
+        full = pd.concat([clean_rows, repaired_rows], ignore_index=True)
+        try:
+            encoded = encode_table(full, self._row_id)
+        except Exception as e:  # never fail the run on a validation error
+            _logger.warning(
+                f"Repair validation skipped: {e.__class__}: {e}")
+            return repair_candidates
+
+        candidate_attrs = sorted(set(repair_candidates["attribute"]))
+        violating: set = set()
+        for d in detectors:
+            try:
+                parsed = d.parsed_constraints(encoded, str(self.input))
+            except Exception as e:
+                _logger.warning(
+                    f"Repair validation skipped for {d}: {e}")
+                continue
+            if parsed.is_empty:
+                continue
+            rid_vals = full[self._row_id].to_numpy()
+            for rows, attr in detect_constraint_violations(
+                    encoded, parsed, candidate_attrs):
+                violating.update(
+                    (rid, attr) for rid in rid_vals[rows].tolist())
+
+        if not violating:
+            return repair_candidates
+        keys = list(zip(repair_candidates[self._row_id].tolist(),
+                        repair_candidates["attribute"].tolist()))
+        keep = np.array([k not in violating for k in keys])
+        dropped = int((~keep).sum())
+        if dropped:
+            _logger.info(
+                f"[Validation Phase] Dropped {dropped}/{len(keys)} repairs "
+                "that still violate integrity constraints")
+        return repair_candidates[keep].reset_index(drop=True)
 
     # -- run ------------------------------------------------------------------
 
@@ -1697,6 +1768,54 @@ class RepairModel:
         dc_plan = self._one_tuple_dc_plan(
             table, continuous_columns, error_cells_df) if not need_pmf else None
         chunk_rows = int(os.environ.get("DELPHI_REPAIR_CHUNK_ROWS", "2000000"))
+
+        if maximal_likelihood_repair:
+            assert len(continuous_columns) == 0
+            assert len(self.cf.targets) == 0  # type: ignore
+            assert not self._repair_by_nearest_values_enabled, \
+                "repairing data by nearest values not supported in this path"
+        elif compute_repair_candidate_prob:
+            assert not self._repair_by_nearest_values_enabled, \
+                "repairing data by nearest values not supported in this path"
+
+        if need_pmf and not repair_data \
+                and chunk_rows > 0 and len(error_row_pos) > chunk_rows:
+            # PMF / maximal-likelihood at scale (reference shape:
+            # model.py:1174-1277): the dirty block, the repaired block, and
+            # the flattened PMF join frames exist only per chunk of dirty
+            # rows — the carried outputs (PMF records / per-cell scores) are
+            # error-cell-sized, and the ML percentile runs once over the
+            # concatenated global scores.
+            ecf_rows = error_cells_df[ROW_IDX].to_numpy().astype(np.int64)
+            pmf_parts: List[pd.DataFrame] = []
+            score_parts: List[pd.DataFrame] = []
+            for start in range(0, len(error_row_pos), chunk_rows):
+                pos = error_row_pos[start:start + chunk_rows]
+                # error_row_pos is sorted-unique, so a chunk's cells are
+                # exactly the cells in its row range
+                cells_chunk = error_cells_df[
+                    (ecf_rows >= pos[0]) & (ecf_rows <= pos[-1])]
+                dirty_chunk = masked.to_pandas(
+                    rows=pos, integral_as_float=float_cols)
+                repaired_chunk = self._repair(
+                    models, continuous_columns, dirty_chunk, cells_chunk,
+                    compute_repair_candidate_prob, maximal_likelihood_repair)
+                if maximal_likelihood_repair:
+                    score_parts.append(self._compute_score(
+                        self._compute_repair_pmf(
+                            repaired_chunk, cells_chunk, [])))
+                else:
+                    pmf_parts.append(self._compute_repair_pmf(
+                        repaired_chunk, cells_chunk, continuous_columns))
+            if maximal_likelihood_repair:
+                score_df = pd.concat(score_parts, ignore_index=True)
+                if compute_repair_score:
+                    return score_df
+                return self._maximal_likelihood_repair(
+                    score_df, error_cells_df)
+            return self._finish_candidate_prob(
+                pd.concat(pmf_parts, ignore_index=True), compute_repair_prob)
+
         if not (need_pmf or repair_data or self.repair_validation_enabled
                 or self.repair_by_rules) \
                 and chunk_rows > 0 and len(error_row_pos) > chunk_rows:
@@ -1725,28 +1844,11 @@ class RepairModel:
             table, dc_plan, error_row_pos, repaired_rows_df, models)
 
         if compute_repair_candidate_prob and not maximal_likelihood_repair:
-            assert not self._repair_by_nearest_values_enabled, \
-                "repairing data by nearest values not supported in this path"
             pmf_df = self._compute_repair_pmf(
                 repaired_rows_df, error_cells_df, continuous_columns)
-            pmf_df = pmf_df.assign(
-                current_value=[cv["value"] for cv in pmf_df["current_value"]])
-            if compute_repair_prob:
-                return pd.DataFrame({
-                    self._row_id: pmf_df[self._row_id],
-                    "attribute": pmf_df["attribute"],
-                    "current_value": pmf_df["current_value"],
-                    "repaired": [p[0]["class"] if p else None for p in pmf_df["pmf"]],
-                    "prob": [p[0]["prob"] if p else None for p in pmf_df["pmf"]],
-                })
-            return pmf_df
+            return self._finish_candidate_prob(pmf_df, compute_repair_prob)
 
         if maximal_likelihood_repair:
-            assert len(continuous_columns) == 0
-            assert len(self.cf.targets) == 0  # type: ignore
-            assert not self._repair_by_nearest_values_enabled, \
-                "repairing data by nearest values not supported in this path"
-
             pmf_df = self._compute_repair_pmf(repaired_rows_df, error_cells_df, [])
             score_df = self._compute_score(pmf_df)
             if compute_repair_score:
@@ -1785,7 +1887,7 @@ class RepairModel:
             clean_rows_df = masked.to_pandas(
                 rows=clean_pos, integral_as_float=float_cols)
             repair_candidates_df = self._validate_repairs(
-                repair_candidates_df, clean_rows_df)
+                repair_candidates_df, repaired_rows_df, clean_rows_df)
         return repair_candidates_df
 
     def _extract_repair_candidates(self, repaired_rows_df: pd.DataFrame,

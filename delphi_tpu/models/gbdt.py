@@ -749,11 +749,6 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                    tuple(cfg_idx))
             merged.setdefault(key, []).append(t)
 
-    remaining_groups = [0] * T
-    for t_members in merged.values():
-        for t in t_members:
-            remaining_groups[t] += 1
-
     for key, t_members in merged.items():
         if timed_out:
             break
@@ -822,10 +817,13 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
 
             slab_data = []
             for slab in slabs:
-                # pad the instance axis to a power of two: few compiled
-                # width variants, and dummy rows (all-zero weights) are
-                # cheap relative to a fresh compile
-                W = 1 << max(0, len(slab) - 1).bit_length()
+                # multi-target slabs pad the instance axis to a power of
+                # two (few compiled width variants; dummy all-zero-weight
+                # rows are cheap relative to a fresh compile); the
+                # single-target search keeps its exact fold count — its
+                # width never varies, so padding would only waste FLOPs
+                W = len(slab) if T == 1 \
+                    else 1 << max(0, len(slab) - 1).bit_length()
                 skey = tuple(slab)
                 if skey not in slab_static_cache:
                     es = [preps[t]["instances"][j] for (t, j) in slab]
@@ -853,10 +851,14 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
         no_improve = {t: 0 for t in members}
         stats_buf: List[Any] = [None] * len(inst)
         for chunk in _round_chunks(g_rounds):
+            # the retirement check runs BEFORE the deadline check: a search
+            # that concluded naturally (patience / good-enough) must not be
+            # reported as timed out just because the clock crossed the
+            # deadline on the same iteration
+            if not any(active[t] and not done[t] for t in members):
+                break
             if deadline is not None and time.monotonic() > deadline:
                 timed_out = True
-                break
-            if not any(active[t] and not done[t] for t in members):
                 break
             fn = _cv_chunk_fn(mesh, chunk, g_depth, n_bins, 1 << g_depth,
                               objective, k)
@@ -937,20 +939,16 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                         active[t] = False
 
         if timed_out:
-            # the deadline hit mid-group: only the targets still searching
-            # lose their CV-proven round counts
+            # the deadline interrupted this group MID-SEARCH: only the
+            # targets still actively improving lose their round counts — a
+            # best checkpoint recorded while chunks were still advancing
+            # may under-state the useful round budget. Targets that
+            # concluded (done, patience-stopped) keep their CV-proven
+            # rounds, and groups the deadline prevented from ever running
+            # cannot invalidate rounds recorded by completed ones.
             for t in members:
-                if not done[t]:
+                if active[t] and not done[t]:
                     timed[t] = True
-        else:
-            for t in t_members:
-                remaining_groups[t] -= 1
-
-    if timed_out:
-        # groups the deadline prevented from ever running
-        for t in range(T):
-            if remaining_groups[t] > 0 and not done[t]:
-                timed[t] = True
 
     out: List[Tuple[int, float, int, bool]] = []
     for t in range(T):

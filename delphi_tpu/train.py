@@ -294,12 +294,17 @@ def _build_jax_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: 
                     # fit's round budget (see _refine_best_config)
                     best_rounds = 0
                 best_cfg = dict(grid[best_ci])
-                best_cfg, best_score, best_rounds = _refine_best_config(
-                    X, y, is_discrete, best_cfg, best_score, best_rounds,
-                    grid, n_splits, class_weight, template, deadline,
-                    no_progress_evals=int(opt(*_opt_no_progress_loss)),
-                    explicit=_opt_no_progress_loss.key in opts,
-                    good_enough=good_enough)
+                if best_score < good_enough:
+                    # refinement only for targets the base grid left below
+                    # the good-enough bar — same gate as the batched path
+                    # (build_models_batched), so the two paths pick
+                    # identical configs
+                    best_cfg, best_score, best_rounds = _refine_best_config(
+                        X, y, is_discrete, best_cfg, best_score, best_rounds,
+                        grid, n_splits, class_weight, template, deadline,
+                        no_progress_evals=int(opt(*_opt_no_progress_loss)),
+                        explicit=_opt_no_progress_loss.key in opts,
+                        good_enough=good_enough)
                 if best_rounds > 0 and is_discrete:
                     # the final fit trains only as many rounds as CV proved
                     # useful for the WINNING config (LightGBM

@@ -36,6 +36,18 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
+# Build the native library on demand so its equivalence tests run by default
+# instead of silently skipping until someone runs `make -C native` by hand.
+# Failure is non-fatal: the tests then skip with their usual reason, and the
+# Python fallbacks remain fully covered either way.
+if not (REPO_ROOT / "native" / "build" / "libdelphi_native.so").exists():
+    import subprocess
+    try:
+        subprocess.run(["make", "-C", str(REPO_ROOT / "native")],
+                       capture_output=True, timeout=120, check=False)
+    except Exception:
+        pass
+
 # Reference fixture CSVs; override when the reference checkout lives
 # elsewhere (e.g. CI clones it into the workspace).
 TESTDATA = pathlib.Path(

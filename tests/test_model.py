@@ -188,3 +188,31 @@ def test_functional_dep_model():
     probs = m.predict_proba(X)
     assert probs[2] is None
     assert set(m.classes_) == {"1", "2"}
+
+
+def test_pmf_and_ml_chunked_paths_match_whole_block(adult, monkeypatch):
+    """DELPHI_REPAIR_CHUNK_ROWS must not change results for the PMF and
+    maximal-likelihood modes: per-chunk PMF extraction concatenates and the
+    ML percentile runs over the concatenated global scores."""
+    from delphi_tpu.costs import Levenshtein
+
+    def run_prob(chunk):
+        monkeypatch.setenv("DELPHI_REPAIR_CHUNK_ROWS", chunk)
+        return _build().setErrorDetectors([NullErrorDetector()]) \
+            .run(compute_repair_prob=True) \
+            .sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+    whole = run_prob("2000000")
+    chunked = run_prob("2")  # 7 cells over ~6 rows -> several chunks
+    pd.testing.assert_frame_equal(whole, chunked)
+
+    def run_ml(chunk):
+        monkeypatch.setenv("DELPHI_REPAIR_CHUNK_ROWS", chunk)
+        return _build().setErrorDetectors([NullErrorDetector()]) \
+            .setRepairDelta(3).setUpdateCostFunction(Levenshtein()) \
+            .run(maximal_likelihood_repair=True) \
+            .sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+    whole_ml = run_ml("2000000")
+    chunked_ml = run_ml("2")
+    pd.testing.assert_frame_equal(whole_ml, chunked_ml)

@@ -518,3 +518,82 @@ def test_onehot_design_matches_dense_logreg():
     # dense input into the compact-fitted model: reconstructed weights
     pd_cross = mc.predict_proba(Xd)
     np.testing.assert_allclose(pd_cross, pd_compact, atol=1e-5)
+
+
+def test_validate_repairs_drops_still_violating_candidates(session):
+    """`_validate_repairs` (the reference's TODO at model.py:1279-1285) must
+    re-evaluate denial constraints over clean + repaired rows and drop only
+    the candidates whose repaired cell still violates."""
+    import numpy as np
+
+    from delphi_tpu import delphi
+
+    clean = pd.DataFrame({
+        "tid": ["1", "2", "3"],
+        "City": ["ba", "ba", "bb"],
+        "State": ["x", "x", "y"]})
+    # row 4's repaired State z violates City->State against rows 1/2;
+    # row 5's repaired State y is consistent with row 3
+    repaired = pd.DataFrame({
+        "tid": ["4", "5"],
+        "City": ["ba", "bb"],
+        "State": ["z", "y"]})
+    candidates = pd.DataFrame({
+        "tid": ["4", "5"],
+        "attribute": ["State", "State"],
+        "current_value": [None, None],
+        "repaired": ["z", "y"]})
+
+    session.register("vtab", pd.concat([clean, repaired], ignore_index=True))
+    m = delphi.repair.setInput("vtab").setRowId("tid").setErrorDetectors([
+        ConstraintErrorDetector(
+            constraints="t1&t2&EQ(t1.City,t2.City)&IQ(t1.State,t2.State)")])
+    out = m._validate_repairs(candidates, repaired, clean)
+    assert out["tid"].tolist() == ["5"], \
+        "the still-violating repair must be dropped, the consistent one kept"
+
+
+def test_repair_validation_enabled_end_to_end(session):
+    """With repair_validation_enabled, a full run never returns a repair
+    that (re-)violates the declared constraints."""
+    import numpy as np
+
+    from delphi_tpu import delphi
+
+    rng = np.random.RandomState(3)
+    n = 120
+    city = rng.choice(["ba", "bb", "bc"], n)
+    state = np.where(city == "ba", "x", np.where(city == "bb", "y", "z"))
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str), "City": city, "State": state})
+    df.loc[rng.choice(n, 12, replace=False), "State"] = None
+    session.register("vtab2", df)
+
+    constraint = "t1&t2&EQ(t1.City,t2.City)&IQ(t1.State,t2.State)"
+    m = delphi.repair.setInput("vtab2").setRowId("tid").setErrorDetectors([
+        NullErrorDetector(), ConstraintErrorDetector(constraints=constraint)])
+    m.repair_validation_enabled = True
+    out = m.run()
+    assert len(out), "the nulled State cells must yield repairs"
+
+    # the validation guarantee: applying the surviving repairs leaves no
+    # repaired cell in violation of the declared constraints
+    applied = df.copy()
+    for tid, attr, rep in zip(out["tid"], out["attribute"], out["repaired"]):
+        applied.loc[applied["tid"] == tid, attr] = rep
+    from delphi_tpu.constraints import (
+        load_constraint_stmts_from_string, parse_and_verify_constraints)
+    from delphi_tpu.ops.detect import detect_constraint_violations
+    from delphi_tpu.table import encode_table
+    encoded = encode_table(applied, "tid")
+    parsed = parse_and_verify_constraints(
+        load_constraint_stmts_from_string(constraint), "vtab2",
+        encoded.column_names)
+    flagged = set()
+    tids = applied["tid"].to_numpy()
+    for rows, attr in detect_constraint_violations(
+            encoded, parsed, ["City", "State"]):
+        flagged.update((tids[r], attr) for r in rows)
+    repaired_cells = set(zip(out["tid"], out["attribute"]))
+    assert not (flagged & repaired_cells), \
+        f"surviving repairs still violate: {sorted(flagged & repaired_cells)}"
