@@ -19,7 +19,7 @@ All detectors return row-index arrays per attribute; the Python wrappers in
 
 import re
 from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -284,6 +284,69 @@ def _jit_sorted_count():
     return kernel
 
 
+def _jit_rank():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(keys):
+        s = jnp.sort(keys)
+        return jnp.searchsorted(s, keys, side="left")
+
+    return kernel
+
+
+_rank_kernel = None
+
+
+def _device_fused_ranks(halves: Sequence[Tuple[np.ndarray, np.ndarray]],
+                        n: int, inv0: Any = None,
+                        return_inv: bool = False) -> Any:
+    """Fuses multi-column join keys into collision-free int64 rank keys ON
+    DEVICE — the accelerator replacement for the host's iterative
+    ``pd.factorize`` passes (composite-EQ keys and the inclusion-exclusion
+    counts used to run factorize on host even on TPU). ``halves`` lists
+    (first[n], second[n]) code-array pairs (NULL code -1 allowed); the two
+    halves concatenate and fuse column by column, re-densifying after each
+    column to its RANK in the sorted key array (sort + searchsorted, the
+    same O(n log n) program shape as `_device_sorted_count`). Ranks live in
+    [0, 2n), so the per-column ``rank * stride + code`` products stay far
+    inside int64 no matter how many columns fuse. The returned keys are
+    COMPARABLE (equal groups share a key), not dense — exactly what the
+    sorted-count/segment kernels need; callers that require dense ids (the
+    host bincount paths) keep factorize.
+
+    ``inv0``: a padded device rank array from a previous call with
+    ``return_inv=True`` — loop-invariant key prefixes (the inclusion-
+    exclusion base group key) rank once and fuse into every subset's key
+    instead of re-sorting per subset. ``return_inv=True`` returns that
+    padded device array instead of the sliced (first, second) host pair."""
+    global _rank_kernel
+    import jax.numpy as jnp
+    from jax import enable_x64
+
+    if _rank_kernel is None:
+        _rank_kernel = _jit_rank()
+    big = np.iinfo(np.int64).max
+    with enable_x64():
+        inv = inv0
+        for first, second in halves:
+            both = np.concatenate([first, second]).astype(np.int64) + 1
+            stride = int(both.max(initial=-1)) + 2
+            if inv is None:
+                # padding sorts last (big), so real ranks land in [0, 2n)
+                # and the padding rows rank to exactly 2n — strictly above
+                # every real key at every later iteration too
+                key = jnp.asarray(_pad_pow2(both, big))
+            else:
+                key = inv * stride + jnp.asarray(_pad_pow2(both, 0))
+            inv = _rank_kernel(key)
+        if return_inv:
+            return inv
+        ranks = np.asarray(inv)[:2 * n]
+    return ranks[:n], ranks[n:]
+
+
 def _jit_group_extrema():
     import jax
     import jax.numpy as jnp
@@ -362,6 +425,16 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     rest = [p for p in preds if not (p.sign == "EQ" and p.is_cross_tuple)]
     n = table.n_rows
 
+    device = _use_device_detect(n)
+    # Device rank keys are collision-free but SPARSE in [0, 2n): they feed
+    # the sorted-count kernels only. The blocked-pairwise fallback (mixed
+    # residuals) builds host bincount tables sized by n_groups, and the
+    # LT/GT segment-extrema kernel allocates n_groups segments — both need
+    # dense ids, so those residuals keep the host factorize for composite
+    # keys (one host pass vs a ~4n-segment device allocation).
+    device_keys = device and (
+        not rest or all(p.sign == "IQ" for p in rest))
+
     # Join keys: left rows keyed by left-attr codes, right rows by right-attr
     # codes, in shared dictionaries (null-safe: NULL code is a key value).
     if len(eq) == 1:
@@ -373,6 +446,16 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         g1 = c1.astype(np.int64) + 1  # NULL -> group 0
         g2 = g1 if c2 is c1 else c2.astype(np.int64) + 1
         n_groups = int(max(g1.max(initial=0), g2.max(initial=0))) + 1 if n else 0
+    elif eq and device_keys:
+        # Composite join key fused ON DEVICE: rank keys from iterated
+        # sort/searchsorted passes — no host factorize scan of the 2n-key
+        # block (the pass the host path below spends its time in).
+        halves = []
+        for p in eq:
+            assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+            halves.append(_shared_codes(table, p.left.name, p.right.name))
+        g1, g2 = _device_fused_ranks(halves, n)
+        n_groups = 2 * n  # rank-key bound (keys are sparse, not dense)
     elif eq:
         # Iterative hash-factorization of the composite join key: O(n) per
         # key column instead of np.unique(axis=0)'s O(n log n) lexicographic
@@ -395,8 +478,6 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     else:
         g1 = g2 = np.zeros(n, dtype=np.int64)
         n_groups = 1 if n else 0
-
-    device = _use_device_detect(n)
 
     if not rest:
         # Violation iff the right-side group is non-empty (self matches).
@@ -476,13 +557,41 @@ def _all_iq_violations(table: EncodedTable, rest: Sequence[Predicate],
     3-predicate constraint on 1e6 rows costs 4 factorize+bincount passes
     instead of an O(n * group) Python pair loop. NULL codes participate as
     ordinary key values, which reproduces the pairwise null-safe semantics
-    (NULL == NULL counts as a match, NULL != value as a mismatch)."""
+    (NULL == NULL counts as a match, NULL != value as a mismatch).
+
+    On an accelerator (`_use_device_detect`), every term's fused key builds
+    on device (`_device_fused_ranks`) and the term count is one
+    `_device_sorted_count` — the factorize+bincount host passes disappear
+    entirely from the detection profile."""
     import pandas as pd
 
     pairs = [_shared_codes(table, p.left.name, p.right.name)  # type: ignore[union-attr]
              for p in rest]
     k = len(pairs)
     total = np.zeros(n, dtype=np.int64)
+
+    if _use_device_detect(n):
+        # the base group key is loop-invariant: rank it once and fuse each
+        # subset's attribute columns on top (the host path hoists its base
+        # factorize the same way)
+        base_inv = _device_fused_ranks([(g2, g1)], n, return_inv=True)
+        base = np.asarray(base_inv)[:2 * n]
+        for s_bits in range(1 << k):
+            # halves concat (first, second) = (right-tuple, left-tuple):
+            # counts over the right side, evaluated at the left rows
+            halves = [(pairs[b][1], pairs[b][0])
+                      for b in range(k) if s_bits >> b & 1]
+            if halves:
+                f_right, f_left = _device_fused_ranks(
+                    halves, n, inv0=base_inv)
+            else:
+                f_right, f_left = base[:n], base[n:]
+            term = _device_sorted_count(f_right, f_left)
+            if bin(s_bits).count("1") % 2:
+                total -= term
+            else:
+                total += term
+        return total > 0
     base = pd.factorize(np.concatenate([g2, g1]).astype(np.int64))[0]
     for s_bits in range(1 << k):
         # fused key: (group, a_p2 for p in S) on the right side, evaluated at

@@ -477,8 +477,16 @@ def test_device_constraint_kernels_match_host(monkeypatch):
         # EQ key + order residual on a numeric column
         "t1&t2&EQ(t1.state,t2.state)&LT(t1.salary,t2.salary)",
         "t1&t2&EQ(t1.state,t2.state)&GT(t1.rate,t2.rate)",
+        # composite EQ keys: device path fuses rank keys on device instead
+        # of the host's iterative factorize
+        "t1&t2&EQ(t1.zip,t2.zip)&EQ(t1.state,t2.state)&IQ(t1.city,t2.city)",
+        "t1&t2&EQ(t1.zip,t2.zip)&EQ(t1.city,t2.city)&LT(t1.salary,t2.salary)",
+        # multiple IQ residuals: device inclusion-exclusion sorted counts
+        "t1&t2&EQ(t1.zip,t2.zip)&IQ(t1.city,t2.city)&IQ(t1.salary,t2.salary)",
+        "t1&t2&EQ(t1.zip,t2.zip)&EQ(t1.state,t2.state)"
+        "&IQ(t1.city,t2.city)&IQ(t1.rate,t2.rate)",
     ], "test_table", df.columns.tolist())
-    assert len(constraints.predicates) == 5
+    assert len(constraints.predicates) == 9
 
     def run(flag):
         monkeypatch.setenv("DELPHI_DEVICE_DETECT", flag)
