@@ -148,6 +148,11 @@ class DomainValues(ErrorDetector):
             col = self._table.column(self.attr)
             counts = np.bincount(col.codes[col.codes >= 0],
                                  minlength=col.domain_size)
+            if self._table.process_local:
+                # autofill thresholds apply to GLOBAL value counts: sum the
+                # per-shard histograms (vocab is already unified)
+                from delphi_tpu.parallel.distributed import allgather_sum
+                counts = allgather_sum(counts)
             domain_values = [str(v) for v, c in zip(col.vocab, counts)
                              if c > self.min_count_thres]
 
@@ -433,6 +438,17 @@ class ErrorModel:
     def _detect_error_cells(self, table: EncodedTable, input_name: str,
                             continuous_columns: List[str]) -> pd.DataFrame:
         detectors = self.error_detectors or self._get_default_error_detectors(table)
+        if table.process_local:
+            # detectors whose evidence is per-shard-local run as-is; the
+            # ones needing global joins/percentiles (DC self-joins, IQR
+            # fences, sklearn fits) are not yet shard-aware
+            supported = (NullErrorDetector, RegExErrorDetector, DomainValues)
+            bad = [d for d in detectors if not isinstance(d, supported)]
+            if bad:
+                raise AnalysisException(
+                    "process-local (sharded-ingestion) repair supports "
+                    "NullErrorDetector/RegExErrorDetector/DomainValues "
+                    f"only, but got: {to_list_str(bad)}")
         _logger.info(
             f"[Error Detection Phase] Used error detectors: {to_list_str(detectors)}")
         target_attrs = self._target_attrs([self.row_id] + table.column_names)
@@ -556,6 +572,15 @@ class ErrorModel:
             noisy_columns = list(factorized[1])
             noisy_cells_df = self._with_current_values(
                 table, noisy_cells_df, factorized=factorized)
+        if table.process_local:
+            # the target-column set must be identical on every process (it
+            # drives the collective sequence of phases 1b-2): union the
+            # per-shard noisy columns, ordered by table column order
+            from delphi_tpu.parallel.distributed import allgather_pickled
+            union = set()
+            for cols in allgather_pickled(noisy_columns):
+                union.update(cols)
+            noisy_columns = [c for c in table.column_names if c in union]
         return noisy_cells_df, noisy_columns
 
     def _compute_attr_stats(self, disc: DiscretizedTable, target_columns: List[str],
@@ -575,7 +600,7 @@ class ErrorModel:
             self._get_option_value(*self._opt_attr_freq_ratio_threshold))
 
         pairwise = compute_pairwise_stats(
-            disc.table.n_rows, freq, candidate_pairs, domain_stats)
+            freq.n_rows, freq, candidate_pairs, domain_stats)
         for t in target_columns:
             pairwise.setdefault(t, [])
         # Engine-internal detail routed by the `repair.logLevel` config key —
@@ -627,7 +652,14 @@ class ErrorModel:
             -> Tuple[pd.DataFrame, List[str], Dict[str, Any], Dict[str, int]]:
         noisy_cells_df, noisy_columns = self._detect_errors(
             table, input_name, continuous_columns)
-        if len(noisy_cells_df) == 0:
+        total_cells = len(noisy_cells_df)
+        if table.process_local:
+            # a shard with zero local cells must still follow the global
+            # control flow (its collectives pair with the other shards')
+            from delphi_tpu.parallel.distributed import allgather_sum
+            total_cells = int(allgather_sum(
+                np.asarray([total_cells], dtype=np.int64))[0])
+        if total_cells == 0:
             return noisy_cells_df, [], {}, {}
 
         disc = discretize_table(table, self.discrete_thres)

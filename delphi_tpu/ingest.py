@@ -272,4 +272,5 @@ def read_csv_encoded_sharded(path: str, row_id: str,
         f"Sharded ingestion: process {rank}/{world} holds {local.n_rows} rows; "
         f"vocabularies unified across hosts")
     return EncodedTable(row_id=local.row_id, row_id_values=local.row_id_values,
-                        row_id_kind=local.row_id_kind, columns=new_columns)
+                        row_id_kind=local.row_id_kind, columns=new_columns,
+                        process_local=True)

@@ -712,6 +712,18 @@ class RepairModel:
             rows=sel_pos, columns=list(feature_map[y]) + [y],
             integral_as_float=float_cols)
         is_discrete = y not in continuous_columns
+        X, y_ = self._encode_training_frame(
+            y, train_pdf, is_discrete, feature_map, transformer_map)
+        return X, y_, len(train_pdf)
+
+    def _encode_training_frame(self, y: str, train_pdf: pd.DataFrame,
+                               is_discrete: bool,
+                               feature_map: Dict[str, List[str]],
+                               transformer_map: Dict[str, List[Any]]) \
+            -> Tuple[Any, Any]:
+        """Fit-encodes a decoded training frame (+ optional rebalancing) —
+        shared by the local and the process-local (gathered-frame) training
+        paths so the encoding semantics cannot drift apart."""
         # linear-head targets train from the factored one-hot design —
         # gathers instead of dense-width matmuls (rebalancing needs row
         # indexing, so it keeps dense)
@@ -720,10 +732,8 @@ class RepairModel:
             compact=not (is_discrete
                          and self.training_data_rebalancing_enabled))
         if is_discrete and self.training_data_rebalancing_enabled:
-            X, y_ = rebalance_training_data(X, train_pdf[y], y)
-        else:
-            y_ = train_pdf[y]
-        return X, y_, len(train_pdf)
+            return rebalance_training_data(X, train_pdf[y], y)
+        return X, train_pdf[y]
 
     def _use_batched_training(self, n_pending: int) -> bool:
         """Whether phase 2 trains its targets through the BATCHED path
@@ -764,6 +774,12 @@ class RepairModel:
         way: only the (capped) per-target sample ever materializes to
         pandas."""
         pending = [c for c in target_columns if c not in models]
+
+        if masked.process_local:
+            return self._build_stat_models_sharded(
+                models, masked, float_cols, target_columns,
+                continuous_columns, num_class_map, feature_map,
+                transformer_map, pending)
 
         if self._use_batched_training(len(pending)):
             tasks = []
@@ -837,6 +853,86 @@ class RepairModel:
             models[y] = (model, feature_map[y], transformer_map[y])
         return models
 
+    def _build_stat_models_sharded(
+            self, models: Dict[str, Any], masked: EncodedTable,
+            float_cols: Tuple[str, ...], target_columns: List[str],
+            continuous_columns: List[str], num_class_map: Dict[str, int],
+            feature_map: Dict[str, List[str]],
+            transformer_map: Dict[str, List[Any]],
+            pending: List[str]) -> Dict[str, Any]:
+        """Phase-2 training for PROCESS-LOCAL shards — the multi-host form
+        of the reference's task-parallel pandas-UDF fan-out
+        (model.py:817-926): for every pending target, each process
+        contributes its shard's (capped) training sample through an
+        all-gather; targets then train round-robin across processes off the
+        identical gathered frames, and the fitted models all-gather back so
+        every process can repair its own dirty rows. No process ever holds
+        more than the capped samples (max_training_row_num x P rows per
+        target in flight)."""
+        import jax
+
+        from delphi_tpu.parallel.distributed import allgather_pickled
+
+        rank, world = jax.process_index(), jax.process_count()
+        max_rows = int(self._get_option_value(*self._opt_max_training_row_num))
+        own: Dict[str, Any] = {}
+        for i, y in enumerate(pending):
+            index = len(models) + i + 1
+            # local sample; EVERY rank participates in the gather (the
+            # collective sequence must match across shards), zero-row
+            # shards contribute an empty frame
+            y_codes = masked.column(y).codes
+            valid_pos = np.flatnonzero(y_codes >= 0)
+            sel_pos = self._sample_training_positions(valid_pos) \
+                if len(valid_pos) else valid_pos
+            local_pdf = masked.to_pandas(
+                rows=sel_pos, columns=list(feature_map[y]) + [y],
+                integral_as_float=float_cols)
+            train_pdf = pd.concat(allgather_pickled(local_pdf),
+                                  ignore_index=True)
+            if len(train_pdf) > max_rows:
+                # deterministic global re-cap (every process computes the
+                # same draw over the identical gathered frame)
+                train_pdf = train_pdf.sample(
+                    frac=float(max_rows) / len(train_pdf),
+                    random_state=42).reset_index(drop=True)
+            if len(train_pdf) == 0:
+                _logger.info(
+                    "Skipping {}/{} model... type=classfier y={} "
+                    "num_class={}".format(index, len(target_columns), y,
+                                          num_class_map[y]))
+                models[y] = (PoorModel(None), feature_map[y], None)
+                continue
+            if i % world != rank:
+                continue  # another process owns this target's fit
+            is_discrete = y not in continuous_columns
+            X, y_ = self._encode_training_frame(
+                y, train_pdf, is_discrete, feature_map, transformer_map)
+            _logger.info(
+                "Building {}/{} model... type={} y={} features={} "
+                "#rows={}{}".format(
+                    index, len(target_columns),
+                    "classfier" if is_discrete else "regressor", y,
+                    to_list_str(feature_map[y]), len(train_pdf),
+                    f" #class={num_class_map[y]}"
+                    if num_class_map[y] > 0 else ""))
+            (model, score), elapsed = build_model(
+                X, y_, is_discrete, num_class_map[y], n_jobs=-1,
+                opts=self.opts)
+            if model is None:
+                model = PoorModel(None)
+            _logger.info(
+                f"Finishes building '{y}' model...  score={score} "
+                f"elapsed={elapsed}s")
+            own[y] = (model, feature_map[y], transformer_map[y])
+
+        # one all-gather distributes every process's fitted models
+        for part in allgather_pickled(own):
+            models.update(part)
+        assert len(models) == len(target_columns), \
+            (sorted(models), target_columns)
+        return models
+
     def _resolve_prediction_order(self, models: Dict[str, Any],
                                   target_columns: List[str]) -> List[Any]:
         """Orders FD models after the attributes they depend on
@@ -897,16 +993,29 @@ class RepairModel:
             is_discrete = y not in continuous_columns
             y_col = masked.column(y)
             y_valid = y_col.codes >= 0
-            num_class_map[y] = int(len(np.unique(y_col.codes[y_valid]))) \
-                if is_discrete else 0
+            class_present = None
+            if is_discrete and masked.process_local:
+                # class counts are GLOBAL facts: union per-shard presence
+                from delphi_tpu.parallel.distributed import allgather_any
+                class_present = np.zeros(max(y_col.domain_size, 1),
+                                         dtype=bool)
+                class_present[np.unique(y_col.codes[y_valid])] = True
+                class_present = allgather_any(class_present)
+                num_class_map[y] = int(class_present.sum())
+            else:
+                num_class_map[y] = int(len(np.unique(y_col.codes[y_valid]))) \
+                    if is_discrete else 0
 
             if is_discrete and num_class_map[y] <= 1:
                 _logger.info(
                     "Skipping {}/{} model... type=rule y={} num_class={}".format(
                         index, len(target_columns), y, num_class_map[y]))
                 v = None
-                if num_class_map[y] == 1 and bool(y_valid.any()):
-                    v = y_col.vocab[y_col.codes[int(np.argmax(y_valid))]]
+                if num_class_map[y] == 1:
+                    if class_present is not None:
+                        v = y_col.vocab[int(np.argmax(class_present))]
+                    elif bool(y_valid.any()):
+                        v = y_col.vocab[y_col.codes[int(np.argmax(y_valid))]]
                 models[y] = (PoorModel(v), input_columns, None)
 
             if y not in models and functional_deps is not None and y in functional_deps:
@@ -1692,6 +1801,40 @@ class RepairModel:
              compute_repair_candidate_prob: bool, compute_repair_prob: bool,
              compute_repair_score: bool, repair_data: bool,
              maximal_likelihood_repair: bool) -> pd.DataFrame:
+        if table.process_local:
+            # Process-local (sharded-ingestion) pipeline: this process holds
+            # only its row shard. Global reductions (freq stats, class
+            # presence, training samples) run through cross-process
+            # collectives; everything row-dimensional — detection, domain
+            # scoring, inference — runs per process on its own device
+            # (`local_compute` pins the generic kernels off the global
+            # mesh), and the returned frame covers THIS process's rows.
+            if compute_repair_candidate_prob or maximal_likelihood_repair:
+                raise ValueError(
+                    "PMF/maximal-likelihood modes are not supported on "
+                    "process-local (sharded-ingestion) tables yet")
+            if self.repair_by_rules:
+                raise ValueError(
+                    "setRepairByRules is not supported on process-local "
+                    "(sharded-ingestion) tables yet")
+            from delphi_tpu.parallel.mesh import local_compute
+            with local_compute():
+                return self._run_impl(
+                    table, input_name, continuous_columns,
+                    detect_errors_only, compute_repair_candidate_prob,
+                    compute_repair_prob, compute_repair_score, repair_data,
+                    maximal_likelihood_repair)
+        return self._run_impl(
+            table, input_name, continuous_columns, detect_errors_only,
+            compute_repair_candidate_prob, compute_repair_prob,
+            compute_repair_score, repair_data, maximal_likelihood_repair)
+
+    def _run_impl(self, table: EncodedTable, input_name: str,
+                  continuous_columns: List[str], detect_errors_only: bool,
+                  compute_repair_candidate_prob: bool,
+                  compute_repair_prob: bool,
+                  compute_repair_score: bool, repair_data: bool,
+                  maximal_likelihood_repair: bool) -> pd.DataFrame:
         #######################################################################
         # 1. Error Detection Phase
         #######################################################################
@@ -1703,7 +1846,14 @@ class RepairModel:
         if detect_errors_only:
             return error_cells_df.drop(columns=[ROW_IDX], errors="ignore")
 
-        if len(error_cells_df) == 0:
+        total_error_cells = len(error_cells_df)
+        if table.process_local:
+            # zero LOCAL cells must not diverge this shard from the global
+            # control flow: its collectives pair with the other shards'
+            from delphi_tpu.parallel.distributed import allgather_sum
+            total_error_cells = int(allgather_sum(
+                np.asarray([total_error_cells], dtype=np.int64))[0])
+        if total_error_cells == 0:
             _logger.info("Any error cell not found, so the input data is already clean")
             if repair_data:
                 return table.to_pandas()
@@ -1731,10 +1881,16 @@ class RepairModel:
         # decodes to float64 in every downstream frame, even if rule repairs
         # later fill all of its NULLs (the old full-frame decode fixed dtypes
         # at this point, and subset decodes must agree with it)
+        nan_flags = np.asarray([
+            c.kind == KIND_INTEGRAL and c.numeric is not None
+            and bool(np.isnan(c.numeric).any()) for c in masked.columns])
+        if table.process_local:
+            # dtype decisions must agree across shards (gathered training
+            # frames concatenate, and output spellings must be uniform)
+            from delphi_tpu.parallel.distributed import allgather_any
+            nan_flags = allgather_any(nan_flags)
         float_cols = tuple(
-            c.name for c in masked.columns
-            if c.kind == KIND_INTEGRAL and c.numeric is not None
-            and bool(np.isnan(c.numeric).any()))
+            c.name for c, f in zip(masked.columns, nan_flags) if f)
 
         repaired_by_rules_df = None
         if self.repair_by_rules:
@@ -1751,8 +1907,11 @@ class RepairModel:
         error_row_pos = np.unique(
             error_cells_df[ROW_IDX].to_numpy().astype(np.int64))
 
+        # checkpoint identity is content-hashed per process; process-local
+        # shards would fingerprint (and race) P different hashes, so the
+        # sharded pipeline skips checkpointing
         fingerprint = self._checkpoint_fingerprint(masked, target_columns) \
-            if self._checkpoint_file() else {}
+            if self._checkpoint_file() and not table.process_local else {}
         models = self._load_model_checkpoint(fingerprint) if fingerprint else None
         if models is None:
             models = self._build_repair_models(

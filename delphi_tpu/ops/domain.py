@@ -57,7 +57,6 @@ def compute_domain_in_error_cells(
     assert 0.0 <= alpha < 1.0 and 0.0 <= beta < 1.0
     assert alpha < beta, "domainThresholdAlpha should be less than domainThresholdBeta"
 
-    n = disc.table.n_rows
     continuous = set(continuous_attrs)
     table = disc.table
 
@@ -169,7 +168,9 @@ def _iter_attr_groups(disc: DiscretizedTable,
     correlate-code assembly."""
     import pandas as pd
 
-    n = disc.table.n_rows
+    # freq.n_rows is the GLOBAL row count (== the local one except for
+    # process-local shards), and tau thresholds must reflect it
+    n = freq.n_rows
     table = disc.table
     continuous = set(continuous_attrs)
     rows_all, attrs_all, curs_all = cells
@@ -229,7 +230,11 @@ def compute_weak_label_mask(
     star)."""
     assert max_attrs_to_compute_domains > 0
     from delphi_tpu.parallel.mesh import get_active_mesh
-    mesh = get_active_mesh()
+    # process-local shards score their OWN cells on their own device — the
+    # cross-process parallelism is the row sharding itself, and the global
+    # evidence (freq tables, taus) is already replicated
+    mesh = None if getattr(disc.table, "process_local", False) \
+        else get_active_mesh()
     table = disc.table
     demote = np.zeros(len(cells[0]), dtype=bool)
 

@@ -147,6 +147,38 @@ def compute_freq_stats(table: EncodedTable,
     codes_np = table.codes(needed)
     name_to_idx = {a: i for i, a in enumerate(needed)}
 
+    # Process-local table (sharded ingestion): every process holds only its
+    # row shard, so the reductions assemble the global device array from
+    # per-process blocks and psum across the process boundary — the global
+    # count tables come back REPLICATED to every process while no host ever
+    # saw the full table (SURVEY.md §2.3 P1, the executor-side aggregation).
+    if getattr(table, "process_local", False):
+        from delphi_tpu.parallel.distributed import allgather_sum
+        from delphi_tpu.parallel.mesh import (
+            make_mesh, shard_rows_process_local)
+        from delphi_tpu.parallel.sharded import (
+            sharded_pair_counts_global, sharded_single_counts_global)
+
+        pl_mesh = make_mesh()
+        garr = shard_rows_process_local(codes_np, pl_mesh, fill=-2)
+        singles_arr = sharded_single_counts_global(garr, v_pad, pl_mesh)
+        singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1]
+                   for a in needed}
+        pair_mats = {}
+        if pairs:
+            idx_pairs = [(name_to_idx[x], name_to_idx[y]) for x, y in pairs]
+            flat = sharded_pair_counts_global(garr, idx_pairs, v_pad, pl_mesh)
+            stride = v_pad + 1
+            for p, (x, y) in enumerate(pairs):
+                m = flat[p].reshape(stride, stride)
+                pair_mats[(x, y)] = m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
+        n_global = int(allgather_sum(
+            np.asarray([table.n_rows], dtype=np.int64))[0])
+        return FreqStats(
+            n_rows=n_global, attrs=attrs, vocab_sizes=vocab_sizes,
+            singles=singles, pairs=pair_mats,
+            threshold_count=int(n_global * attr_freq_ratio_threshold))
+
     # Multi-device path: when a mesh is active (DELPHI_MESH / repair.mesh),
     # the same reductions run row-sharded over the dp axis with psum over
     # ICI replacing the Spark shuffle (SURVEY.md §2.3 P1).
@@ -250,10 +282,44 @@ class PairDistinctCounter:
     def __init__(self, table: EncodedTable) -> None:
         self._table = table
         self._cache: Dict[frozenset, int] = {}
+        self._global_rows_cache: Optional[int] = None
 
     @property
     def n_rows(self) -> int:
-        return self._table.n_rows
+        # GLOBAL rows: candidate selection compares domain sizes (global
+        # facts) against this, and its decisions drive the cross-process
+        # collective sequence — a local count would desynchronize shards
+        return self._global_rows()
+
+    def _global_rows(self) -> int:
+        """Global row count — the local count for normal tables, the
+        allgathered sum for process-local shards (the value must be
+        IDENTICAL on every process so warm's size branches agree)."""
+        if self._global_rows_cache is None:
+            n = self._table.n_rows
+            if getattr(self._table, "process_local", False):
+                from delphi_tpu.parallel.distributed import allgather_sum
+                n = int(allgather_sum(np.asarray([n], dtype=np.int64))[0])
+            self._global_rows_cache = n
+        return self._global_rows_cache
+
+    def _merge_global(self, count: int) -> int:
+        """Cross-process merge of a per-shard distinct-pair count: the MAX
+        over shards — a deterministic lower bound of the global distinct
+        count (exactness would need the pair matrix the pruning exists to
+        avoid). Every process derives the identical value, so candidate
+        selection stays consistent across the cluster."""
+        return self._merge_global_many([count])[0]
+
+    def _merge_global_many(self, counts: List[int]) -> List[int]:
+        """Batch form of `_merge_global`: ONE collective merges a whole
+        warm pass's counts instead of a cross-process round-trip per
+        pair."""
+        if not getattr(self._table, "process_local", False) or not counts:
+            return list(counts)
+        from delphi_tpu.parallel.distributed import allgather_max
+        return [int(c) for c in
+                allgather_max(np.asarray(counts, dtype=np.int64))]
 
     def warm(self, pairs) -> None:
         todo = []
@@ -263,21 +329,23 @@ class PairDistinctCounter:
             if key not in self._cache and key not in seen:
                 seen.add(key)
                 todo.append((x, y))
-        if len(todo) < 2 or self._table.n_rows < (1 << 14):
+        if len(todo) < 2 or self._global_rows() < (1 << 14):
             return  # host path is cheaper than a kernel launch
         if jax.default_backend() == "cpu":
             # the device kernel is an O(n log n) lexsort per pair — on the
             # CPU backend the host's O(n) factorize hash pass wins ~7x
             # (55s -> 8s for the hospital-scale pair-pruning sweep at 2M)
-            for x, y in todo:
-                self._cache[frozenset((x, y))] = \
-                    self._host_distinct_pair_count(x, y)
+            merged = self._merge_global_many(
+                [self._host_distinct_pair_count(x, y) for x, y in todo])
+            for (x, y), c in zip(todo, merged):
+                self._cache[frozenset((x, y))] = c
             return
         # Bound the [chunk, rows] code stacks (x2 attrs + lexsort workspace)
         # to ~1 GB regardless of table size.
         chunk_size = max(1, min(self._WARM_CHUNK,
                                 int(_PAIR_KEYS_PER_LAUNCH
                                     // self._table.n_rows)))
+        local_counts = []
         for s in range(0, len(todo), chunk_size):
             chunk = todo[s:s + chunk_size]
             # pad short chunks by repeating the last pair so every launch
@@ -288,8 +356,9 @@ class PairDistinctCounter:
             counts = np.asarray(
                 _batched_distinct_pair_counts(jnp.asarray(c1),
                                               jnp.asarray(c2)))
-            for (x, y), c in zip(chunk, counts[:len(chunk)]):
-                self._cache[frozenset((x, y))] = int(c)
+            local_counts.extend(int(c) for c in counts[:len(chunk)])
+        for (x, y), c in zip(todo, self._merge_global_many(local_counts)):
+            self._cache[frozenset((x, y))] = c
 
     def _host_distinct_pair_count(self, x: str, y: str) -> int:
         import pandas as pd
@@ -303,7 +372,8 @@ class PairDistinctCounter:
     def distinct_pair_count(self, x: str, y: str) -> int:
         key = frozenset((x, y))
         if key not in self._cache:
-            self._cache[key] = self._host_distinct_pair_count(x, y)
+            self._cache[key] = self._merge_global(
+                self._host_distinct_pair_count(x, y))
         return self._cache[key]
 
 

@@ -77,3 +77,51 @@ def allgather_host_bytes(payload: bytes) -> list:
             for i in range(len(lengths))]
 
 
+
+def allgather_sum(arr):
+    """Elementwise sum of a small numeric array across processes (global
+    counts from per-shard counts). Identity when single-process."""
+    import jax
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr)).sum(axis=0)
+
+
+def allgather_any(mask):
+    """Elementwise logical OR of a small bool array across processes
+    (global presence masks from per-shard masks)."""
+    import jax
+    import numpy as np
+
+    mask = np.asarray(mask, dtype=bool)
+    if jax.process_count() == 1:
+        return mask
+    from jax.experimental import multihost_utils
+    return np.asarray(
+        multihost_utils.process_allgather(mask)).any(axis=0)
+
+
+def allgather_max(arr):
+    """Elementwise max of a small numeric array across processes."""
+    import jax
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr)).max(axis=0)
+
+
+def allgather_pickled(obj) -> list:
+    """All-gathers one picklable object per process (training-sample frames
+    and trained models in the process-local pipeline). Returns the P
+    objects in process order on every process."""
+    import pickle
+
+    return [pickle.loads(b)
+            for b in allgather_host_bytes(pickle.dumps(obj))]

@@ -26,6 +26,27 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
 
 _active_mesh_cache: dict = {}
 
+_local_compute_depth = 0
+
+
+class local_compute:
+    """Context manager that forces `get_active_mesh` to answer None: inside
+    it, every generic kernel (training, inference, domain scoring) runs
+    single-device on THIS process's data. The process-local repair pipeline
+    (sharded ingestion, `EncodedTable.process_local`) uses it because its
+    parallelism is one process per row shard — the global reductions that
+    DO need the cross-process mesh (freq stats) build theirs explicitly via
+    `make_mesh` instead."""
+
+    def __enter__(self) -> "local_compute":
+        global _local_compute_depth
+        _local_compute_depth += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _local_compute_depth
+        _local_compute_depth -= 1
+
 
 def get_active_mesh() -> Optional[Mesh]:
     """The mesh the PIPELINE's stats kernels run on, or None for the
@@ -37,6 +58,8 @@ def get_active_mesh() -> Optional[Mesh]:
     ``DELPHI_MESH=off``; the session config key ``repair.mesh`` accepts the
     same values. This is the switch that turns the engine's reductions into
     psum'd SPMD programs (SURVEY.md §2.3 P1) without touching user code."""
+    if _local_compute_depth:
+        return None
     setting = os.environ.get("DELPHI_MESH", "")
     if not setting:
         from delphi_tpu.session import get_session

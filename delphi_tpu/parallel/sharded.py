@@ -49,6 +49,16 @@ def sharded_pair_counts(codes: np.ndarray, pairs: Sequence[Tuple[int, int]],
     returns int32[n_pairs, (v_pad+1)**2]."""
     dp = mesh.shape["dp"]
     padded, n = pad_rows_to_multiple(codes, dp, fill=-2)
+    return sharded_pair_counts_global(
+        shard_rows(padded, mesh), pairs, v_pad, mesh)
+
+
+def sharded_pair_counts_global(global_codes, pairs: Sequence[Tuple[int, int]],
+                               v_pad: int, mesh: Mesh) -> np.ndarray:
+    """`sharded_pair_counts` over a pre-assembled global device array — the
+    entry point for sharded ingestion, where each process contributed its
+    own rows via `shard_rows_process_local` (padding rows = -2) and no host
+    ever saw the full table."""
     xi = jnp.asarray([p[0] for p in pairs], dtype=jnp.int32)
     yi = jnp.asarray([p[1] for p in pairs], dtype=jnp.int32)
     stride = v_pad + 1
@@ -66,7 +76,7 @@ def sharded_pair_counts(codes: np.ndarray, pairs: Sequence[Tuple[int, int]],
         counts = jax.vmap(one)(xi, yi)
         return jax.lax.psum(counts, "dp")
 
-    return np.asarray(kernel(shard_rows(padded, mesh), xi, yi))
+    return np.asarray(kernel(global_codes, xi, yi))
 
 
 def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],

@@ -196,6 +196,12 @@ class EncodedTable:
     row_id_values: np.ndarray
     row_id_kind: str
     columns: List[EncodedColumn] = field(default_factory=list)
+    # True when this table holds only THIS PROCESS's row shard of a larger
+    # multi-host table (sharded ingestion): vocabularies are globally
+    # unified, rows are local. The repair pipeline then runs its global
+    # reductions through cross-process collectives and everything
+    # row-dimensional per process — no host ever materializes the table.
+    process_local: bool = False
 
     @property
     def n_rows(self) -> int:
@@ -438,6 +444,8 @@ class DiscretizedTable:
 def discretize_table(table: EncodedTable, discrete_threshold: int) -> DiscretizedTable:
     assert 2 <= discrete_threshold < 65536, "discreteThreshold should be in [2, 65536)."
 
+    process_local = table.process_local
+
     out_columns: List[EncodedColumn] = []
     domain_stats: Dict[str, int] = {}
     for c in table.columns:
@@ -445,11 +453,20 @@ def discretize_table(table: EncodedTable, discrete_threshold: int) -> Discretize
         if c.is_numeric:
             assert c.numeric is not None
             valid = ~np.isnan(c.numeric)
-            if not valid.any():
+            any_valid = bool(valid.any())
+            vmin = float(np.nanmin(c.numeric)) if any_valid else np.inf
+            vmax = float(np.nanmax(c.numeric)) if any_valid else -np.inf
+            if process_local:
+                # bin fences must come from the GLOBAL extrema so every
+                # process bins its shard identically
+                from delphi_tpu.parallel.distributed import allgather_max
+                vmax, neg_vmin = (float(v) for v in allgather_max(
+                    np.asarray([vmax, -vmin], dtype=np.float64)))
+                vmin = -neg_vmin
+                any_valid = np.isfinite(vmin)
+            if not any_valid:
                 _logger.warning(f"'{c.name}' dropped because it has no non-NULL value")
                 continue
-            vmin = float(np.nanmin(c.numeric))
-            vmax = float(np.nanmax(c.numeric))
             width = vmax - vmin
             bins = np.full(table.n_rows, NULL_CODE, dtype=np.int64)
             if width > 0.0:
@@ -458,8 +475,17 @@ def discretize_table(table: EncodedTable, discrete_threshold: int) -> Discretize
             else:
                 bins[valid] = 0
             # Re-encode bins compactly: vocab entries are the bin values as
-            # strings (what CAST(int AS STRING) would yield in the reference).
-            present = np.unique(bins[bins >= 0])
+            # strings (what CAST(int AS STRING) would yield in the
+            # reference). Process-local shards take the GLOBAL present-bin
+            # union so codes stay comparable across processes.
+            if process_local:
+                from delphi_tpu.parallel.distributed import allgather_any
+                mask = np.zeros(discrete_threshold + 1, dtype=bool)
+                local_present = np.unique(bins[bins >= 0])
+                mask[local_present] = True
+                present = np.nonzero(allgather_any(mask))[0]
+            else:
+                present = np.unique(bins[bins >= 0])
             remap = {int(b): i for i, b in enumerate(present)}
             codes = np.array([remap[int(b)] if b >= 0 else NULL_CODE for b in bins],
                              dtype=np.int32)
@@ -477,5 +503,6 @@ def discretize_table(table: EncodedTable, discrete_threshold: int) -> Discretize
         row_id_values=table.row_id_values,
         row_id_kind=table.row_id_kind,
         columns=out_columns,
+        process_local=process_local,
     )
     return DiscretizedTable(base=table, table=discretized, domain_stats=domain_stats)
