@@ -1981,6 +1981,7 @@ class RepairModel:
             # candidates-only at scale: decode + repair + extract per chunk of
             # dirty rows so no full dirty block ever materializes at once
             parts = []
+            ecf_rows = error_cells_df[ROW_IDX].to_numpy().astype(np.int64)
             for start in range(0, len(error_row_pos), chunk_rows):
                 pos = error_row_pos[start:start + chunk_rows]
                 dirty_chunk = masked.to_pandas(
@@ -1990,8 +1991,15 @@ class RepairModel:
                     compute_repair_candidate_prob, maximal_likelihood_repair)
                 repaired_chunk = self._minimize_one_tuple_dc_repairs(
                     table, dc_plan, pos, repaired_chunk, models)
+                # pre-slice the chunk's cells (error_row_pos is sorted, so a
+                # chunk's cells are exactly the cells in its row range):
+                # the extraction then touches only chunk-sized arrays
+                cells_chunk = error_cells_df[
+                    (ecf_rows >= pos[0]) & (ecf_rows <= pos[-1])]
                 parts.append(self._extract_repair_candidates(
-                    repaired_chunk, error_cells_df, target_columns))
+                    repaired_chunk, cells_chunk, target_columns, pos))
+            # row-major per chunk + ascending chunks = global row-major,
+            # identical to the one-shot path's order
             return pd.concat(parts, ignore_index=True)
 
         dirty_rows_df = masked.to_pandas(
@@ -2031,7 +2039,7 @@ class RepairModel:
             return clean_df
 
         repair_candidates_df = self._extract_repair_candidates(
-            repaired_rows_df, error_cells_df, target_columns)
+            repaired_rows_df, error_cells_df, target_columns, error_row_pos)
 
         if self.repair_by_rules and repaired_by_rules_df is not None \
                 and len(repaired_by_rules_df):
@@ -2051,30 +2059,66 @@ class RepairModel:
 
     def _extract_repair_candidates(self, repaired_rows_df: pd.DataFrame,
                                    error_cells_df: pd.DataFrame,
-                                   target_columns: List[str]) -> pd.DataFrame:
-        """Result shaping for the candidates path: the long view of the
-        repaired dirty block inner-joined to the error cells, keeping repairs
-        that changed the value or stayed NULL (reference model.py:1391-1408).
-        Only target columns flatten — error cells live nowhere else, so the
-        join output is identical and the long view shrinks by attrs/targets."""
-        flatten_cols = [self._row_id] + [
-            c for c in repaired_rows_df.columns if c in set(target_columns)]
-        flat = self._flatten(repaired_rows_df[flatten_cols])
-        repair_candidates_df = flat.merge(
-            error_cells_df[[self._row_id, "attribute", "current_value"]],
-            on=[self._row_id, "attribute"], how="inner") \
-            .rename(columns={"value": "repaired"})
-        repair_candidates_df = repair_candidates_df[
-            [self._row_id, "attribute", "current_value", "repaired"]]
-        # keep cells whose repair stayed NULL ("couldn't repair") — reference
-        # result shaping `repaired IS NULL OR NOT(current <=> repaired)`
-        # (model.py:1391-1408); pandas turns None into NaN on assignment, so
-        # test via _is_null rather than `is None`
-        changed = [
-            _is_null(r) or not _null_safe_eq(c, r)
-            for c, r in zip(repair_candidates_df["current_value"],
-                            repair_candidates_df["repaired"])]
-        return repair_candidates_df[changed].reset_index(drop=True)
+                                   target_columns: List[str],
+                                   row_pos: np.ndarray) -> pd.DataFrame:
+        """Result shaping for the candidates path, INTEGER-KEYED: the
+        repaired block's rows correspond positionally to ``row_pos`` (the
+        sorted global row positions it was decoded from), so each error
+        cell's repaired value is a direct positional gather + one
+        stringify pass per attribute — no melt of the repaired block and
+        no object-key join (the reference shapes the same result via a SQL
+        flatten + join, model.py:1391-1408; those passes dominated the
+        repair tail at the 1e8-row scale). Output reproduces the legacy
+        flatten+join shape exactly: stringified repaired values, row-major
+        order (a row's cells together, attributes in column order), and
+        the keep rule `repaired IS NULL OR NOT(current <=> repaired)` —
+        repairs that changed the value or stayed NULL ("couldn't
+        repair")."""
+        empty = pd.DataFrame(
+            columns=[self._row_id, "attribute", "current_value", "repaired"])
+        cells_rows = error_cells_df[ROW_IDX].to_numpy().astype(np.int64)
+        if not len(row_pos) or not len(cells_rows):
+            return empty
+        in_chunk = (cells_rows >= row_pos[0]) & (cells_rows <= row_pos[-1])
+        if not in_chunk.all():
+            error_cells_df = error_cells_df[in_chunk]
+            cells_rows = cells_rows[in_chunk]
+            if not len(cells_rows):
+                return empty
+        local = np.searchsorted(row_pos, cells_rows)
+        attrs_np = error_cells_df["attribute"].to_numpy(dtype=object)
+        curs_np = error_cells_df["current_value"].to_numpy(dtype=object)
+        rid_np = error_cells_df[self._row_id].to_numpy()
+        attr_codes, attr_uniques = pd.factorize(attrs_np)
+        col_rank = {a: i for i, a in enumerate(repaired_rows_df.columns)}
+        target_set = set(target_columns)
+        repaired = np.empty(len(cells_rows), dtype=object)
+        valid = np.zeros(len(cells_rows), dtype=bool)
+        for ai, attr in enumerate(attr_uniques):
+            if attr not in target_set or attr not in col_rank:
+                continue  # the legacy inner join dropped these cells
+            m = attr_codes == ai
+            repaired[m] = _flatten_column(
+                repaired_rows_df[attr].iloc[local[m]])
+            valid[m] = True
+        # pandas turns None into NaN on assignment, so test via _is_null
+        # rather than `is None`
+        keep = valid & np.fromiter(
+            (_is_null(r) or not _null_safe_eq(c, r)
+             for c, r in zip(curs_np, repaired)),
+            dtype=bool, count=len(cells_rows))
+        if not keep.any():
+            return empty
+        ranks = np.fromiter((col_rank.get(a, 0) for a in attrs_np),
+                            dtype=np.int64, count=len(attrs_np))
+        order = np.lexsort((ranks[keep], local[keep]))  # row-major
+        idx = np.nonzero(keep)[0][order]
+        return pd.DataFrame({
+            self._row_id: rid_np[idx],
+            "attribute": attrs_np[idx],
+            "current_value": curs_np[idx],
+            "repaired": repaired[idx],
+        })
 
     def _check_input_table(self) -> Tuple[EncodedTable, str, List[str]]:
         if isinstance(self.input, str):
