@@ -129,6 +129,7 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
         "repairs": int(len(repaired)),
         "elapsed_s": round(elapsed, 3),
         "device": device,
+        "peak_rss_gb": _peak_rss_gb(),
         **extra,
     }), flush=True)
 
@@ -202,6 +203,7 @@ def flights(scale: int, profile: bool = False) -> None:
         "repairs": int(len(repaired)),
         "cells_per_sec": round(len(repaired) / elapsed, 1) if elapsed else 0.0,
         "device": device,
+        "peak_rss_gb": _peak_rss_gb(),
     }
     if util is not None:
         result.update(util.stop(elapsed))
@@ -274,6 +276,20 @@ def _persist_tpu_result(args: argparse.Namespace, parsed: dict) -> None:
         os.replace(tmp, TPU_RESULTS_PATH)
     except Exception as e:
         print(f"could not persist TPU result: {e}", file=sys.stderr)
+
+
+def _peak_rss_gb() -> float:
+    """Peak resident set size of this process in GB (VmHWM), 0.0 when
+    unavailable — memory headroom is the binding constraint of the
+    single-host north-star runs, so the bench records it."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return round(int(line.split()[1]) / 1048576.0, 2)
+    except Exception:
+        pass
+    return 0.0
 
 
 def _heartbeat(msg: str) -> None:

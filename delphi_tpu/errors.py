@@ -222,7 +222,13 @@ class GaussianOutlierErrorDetector(ErrorDetector):
     (ErrorDetectorApi.scala:249-300): exact per-column quartiles at the
     1e8-row scale cost an O(n) introselect + copy per column, while
     quartiles of a 1e5 sample are O(sample) and within sampling noise for
-    any IQR-fence purpose (the fences then apply to EVERY row exactly)."""
+    any IQR-fence purpose (the fences then apply to EVERY row exactly).
+
+    On process-local shards (sharded ingestion) the fences come from an
+    all-gathered, row-weighted pool of per-shard samples regardless of
+    ``approx_enabled`` — the reference's distributed detector likewise
+    always runs `approx_percentile`; columns within the sample budget
+    gather in full and stay exact."""
 
     def __init__(self, approx_enabled: bool = False) -> None:
         ErrorDetector.__init__(self)
@@ -439,16 +445,20 @@ class ErrorModel:
                             continuous_columns: List[str]) -> pd.DataFrame:
         detectors = self.error_detectors or self._get_default_error_detectors(table)
         if table.process_local:
-            # detectors whose evidence is per-shard-local run as-is; the
-            # ones needing global joins/percentiles (DC self-joins, IQR
-            # fences, sklearn fits) are not yet shard-aware
-            supported = (NullErrorDetector, RegExErrorDetector, DomainValues)
+            # detectors whose evidence is per-shard-local (or reduced
+            # through collectives: autofill counts, gathered percentile
+            # pools) run as-is; the ones needing global joins or
+            # whole-column model fits (DC self-joins, sklearn detectors)
+            # are not yet shard-aware
+            supported = (NullErrorDetector, RegExErrorDetector, DomainValues,
+                         GaussianOutlierErrorDetector)
             bad = [d for d in detectors if not isinstance(d, supported)]
             if bad:
                 raise AnalysisException(
                     "process-local (sharded-ingestion) repair supports "
-                    "NullErrorDetector/RegExErrorDetector/DomainValues "
-                    f"only, but got: {to_list_str(bad)}")
+                    "NullErrorDetector/RegExErrorDetector/DomainValues/"
+                    "GaussianOutlierErrorDetector only, but got: "
+                    f"{to_list_str(bad)}")
         _logger.info(
             f"[Error Detection Phase] Used error detectors: {to_list_str(detectors)}")
         target_attrs = self._target_attrs([self.row_id] + table.column_names)

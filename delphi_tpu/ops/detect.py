@@ -75,7 +75,13 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
     (ErrorDetectorApi.scala:249-300): flag values outside
     [q1 - 1.5*IQR, q3 + 1.5*IQR]. With ``approx``, columns larger than
     ``APPROX_PERCENTILE_SAMPLE`` estimate q1/q3 from a seeded random sample
-    (the `approx_percentile` analog); the fences still apply to every row."""
+    (the `approx_percentile` analog); the fences still apply to every row.
+
+    Process-local shards compute their fences from an all-gathered pool of
+    per-shard samples — exactly the reference's distributed form (its
+    detector runs `approx_percentile` over the cluster) — and apply them to
+    their own rows; every process derives identical fences."""
+    process_local = getattr(table, "process_local", False)
     out = []
     attrs = [a for a in continuous_attrs if a in target_attrs]
     for attr in attrs:
@@ -83,10 +89,36 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
         assert col.numeric is not None
         values = col.numeric
         valid = ~np.isnan(values)
-        if not valid.any():
+        if not valid.any() and not process_local:
             continue
         pool = values[valid]
-        if approx and len(pool) > APPROX_PERCENTILE_SAMPLE:
+        if process_local:
+            # every shard joins BOTH gathers (a locally-empty column must
+            # not desynchronize the collective sequence); skip only when
+            # the column is empty GLOBALLY. Above the sample budget the
+            # shards contribute ROW-WEIGHTED quotas, so the gathered pool
+            # matches the single-process sample distribution (the
+            # reference's distributed approx_percentile is row-weighted
+            # the same way).
+            from delphi_tpu.parallel.distributed import allgather_pickled
+            counts = allgather_pickled(int(len(pool)))
+            total = int(sum(counts))
+            if total > APPROX_PERCENTILE_SAMPLE and len(pool):
+                if not approx:
+                    _logger.info(
+                        f"{attr}: process-local fences come from the "
+                        "row-weighted sampled pool (the reference's "
+                        "distributed approx_percentile semantics)")
+                quota = max(1, int(round(
+                    APPROX_PERCENTILE_SAMPLE * len(pool) / total)))
+                rng = np.random.RandomState(42)
+                pool = pool[rng.randint(0, len(pool), quota)]
+            pool = np.concatenate(
+                [np.asarray(p, dtype=np.float64)
+                 for p in allgather_pickled(pool)])
+            if not len(pool):
+                continue
+        elif approx and len(pool) > APPROX_PERCENTILE_SAMPLE:
             # with-replacement index draw: O(sample) work and memory
             # (choice(replace=False) would permute the whole column)
             rng = np.random.RandomState(42)

@@ -260,7 +260,7 @@ except Exception:
     pass
 
 import pandas as pd
-from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import GaussianOutlierErrorDetector, NullErrorDetector, delphi
 from delphi_tpu.ingest import read_csv_encoded, read_csv_encoded_sharded
 
 if mode != "single":
@@ -269,23 +269,27 @@ if mode != "single":
     assert jax.process_count() == 2
 
 path = os.environ["CSV"]
+dtypes = {"tid": str, "City": str, "State": str, "County": str,
+          "Score": "float64"}
 if mode == "single":
-    table = read_csv_encoded(path, "tid", chunksize=50)
+    table = read_csv_encoded(path, "tid", chunksize=50, dtype=dtypes)
 else:
-    table = read_csv_encoded_sharded(path, "tid", chunksize=50)
+    table = read_csv_encoded_sharded(path, "tid", chunksize=50, dtype=dtypes)
     assert table.process_local
     # the process-local pipeline must not let this shard see the others
     full_rows = int(os.environ["N_ROWS"])
     assert table.n_rows < full_rows, table.n_rows
 
 delphi.register_table("shardtab", table)
+detectors = [NullErrorDetector(), GaussianOutlierErrorDetector()]
 rep = delphi.repair \
     .setTableName("shardtab").setRowId("tid") \
-    .setErrorDetectors([NullErrorDetector()]) \
+    .setTargets(["City", "State", "County"]) \
+    .setErrorDetectors(list(detectors)) \
     .run()
 det = delphi.repair \
     .setTableName("shardtab").setRowId("tid") \
-    .setErrorDetectors([NullErrorDetector()]) \
+    .setErrorDetectors(list(detectors)) \
     .run(detect_errors_only=True)
 
 out = os.environ["OUT"] + ("_single" if mode == "single" else f"_r{mode}")
@@ -314,9 +318,20 @@ def test_two_process_sharded_pipeline(tmp_path):
     state = np.where(city == "ba", "x", np.where(city == "bb", "y",
                      np.where(city == "bc", "z", "w")))
     cnty = np.where(np.isin(city, ["ba", "bb"]), "north", "south")
+    score = np.round(rng.randn(n) * 2.0 + 50.0, 3)
+    # Score is NaN on every row of rank 1's chunks (chunksize=50,
+    # round-robin i % 2 -> rows 50-99, 150-199, ...): that shard's local
+    # percentile pool is EMPTY, exercising the desync guard where a
+    # locally-empty column must still join the fence all-gathers
+    for lo in range(50, n, 100):
+        score[lo:lo + 50] = np.nan
+    outlier_rows = rng.choice(np.concatenate(
+        [np.arange(lo, lo + 50) for lo in range(0, n, 100)]), 5,
+        replace=False)
+    score[outlier_rows] = 9999.0  # IQR outliers, all on rank 0's rows
     df = pd.DataFrame({
         "tid": np.arange(n).astype(str), "City": city, "State": state,
-        "County": cnty})
+        "County": cnty, "Score": score})
     df.loc[rng.choice(n, 40, replace=False), "State"] = None
     df.loc[rng.choice(n, 30, replace=False), "County"] = None
     csv = tmp_path / "shard_input.csv"
@@ -374,7 +389,10 @@ def test_two_process_sharded_pipeline(tmp_path):
     det_s = det_s.sort_values(key).reset_index(drop=True)
     det_m = det_m.sort_values(key).reset_index(drop=True)
     # detection is exact: the shard union covers the same cells
-    pd.testing.assert_frame_equal(det_m[det_s.columns], det_s)
+    # (check_dtype=False: the JSON round-trip types an all-string column
+    # differently from the concat carrying the Score NaNs)
+    pd.testing.assert_frame_equal(det_m[det_s.columns], det_s,
+                                  check_dtype=False)
     assert len(det_s) > 0
 
     rep_s = rep_s.sort_values(key).reset_index(drop=True)
