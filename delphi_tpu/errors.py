@@ -445,20 +445,20 @@ class ErrorModel:
                             continuous_columns: List[str]) -> pd.DataFrame:
         detectors = self.error_detectors or self._get_default_error_detectors(table)
         if table.process_local:
-            # detectors whose evidence is per-shard-local (or reduced
-            # through collectives: autofill counts, gathered percentile
-            # pools) run as-is; the ones needing global joins or
-            # whole-column model fits (DC self-joins, sklearn detectors)
-            # are not yet shard-aware
+            # detectors whose evidence is per-shard-local or reduced
+            # through collectives (autofill counts, gathered percentile
+            # pools, dense global group statistics for the DC kernels) run
+            # as-is; whole-column sklearn model fits are not shard-aware
             supported = (NullErrorDetector, RegExErrorDetector, DomainValues,
-                         GaussianOutlierErrorDetector)
+                         GaussianOutlierErrorDetector,
+                         ConstraintErrorDetector)
             bad = [d for d in detectors if not isinstance(d, supported)]
             if bad:
                 raise AnalysisException(
                     "process-local (sharded-ingestion) repair supports "
                     "NullErrorDetector/RegExErrorDetector/DomainValues/"
-                    "GaussianOutlierErrorDetector only, but got: "
-                    f"{to_list_str(bad)}")
+                    "GaussianOutlierErrorDetector/ConstraintErrorDetector "
+                    f"only, but got: {to_list_str(bad)}")
         _logger.info(
             f"[Error Detection Phase] Used error detectors: {to_list_str(detectors)}")
         target_attrs = self._target_attrs([self.row_id] + table.column_names)

@@ -977,6 +977,15 @@ class RepairModel:
 
         functional_deps = self._get_functional_deps(train_columns, target_columns) \
             if self._repair_by_functional_deps_enabled else None
+        if functional_deps and masked.process_local:
+            # an FD rule's value map would come from THIS shard's pairs
+            # only — different maps on different processes. Stat models
+            # (trained on the gathered global sample) repair those targets
+            # instead.
+            _logger.info(
+                "Functional-dep rule models are disabled on process-local "
+                "shards; their targets train stat models")
+            functional_deps = None
         if functional_deps:
             _logger.info(f"Functional deps found: {functional_deps}")
 
@@ -1817,6 +1826,14 @@ class RepairModel:
                 raise ValueError(
                     "setRepairByRules is not supported on process-local "
                     "(sharded-ingestion) tables yet")
+            if self.repair_validation_enabled:
+                # validation would re-encode only THIS shard's rows, so a
+                # repair violating a constraint against another shard's
+                # rows would silently survive — refuse rather than degrade
+                raise ValueError(
+                    "repair validation is not supported on process-local "
+                    "(sharded-ingestion) tables yet: it would check "
+                    "constraints against this shard's rows only")
             from delphi_tpu.parallel.mesh import local_compute
             with local_compute():
                 return self._run_impl(

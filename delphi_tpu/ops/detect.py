@@ -24,6 +24,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from delphi_tpu.constraints import AttrRef, Constant, DenialConstraints, Predicate
+from delphi_tpu.session import AnalysisException
 from delphi_tpu.table import EncodedTable, NULL_CODE
 from delphi_tpu.utils import setup_logger
 
@@ -145,13 +146,16 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
     return out
 
 
-def _shared_codes(table: EncodedTable, left: str, right: str) \
-        -> Tuple[np.ndarray, np.ndarray]:
-    """Codes for two columns in a shared dictionary so cross-attribute
-    equality can compare codes directly. NULL stays -1."""
+def _shared_codes_sized(table: EncodedTable, left: str, right: str) \
+        -> Tuple[np.ndarray, np.ndarray, int]:
+    """Codes for two columns in a shared dictionary (so cross-attribute
+    equality can compare codes directly; NULL stays -1) plus that
+    dictionary's size. The size derives from the columns' vocabularies, so
+    on sharded tables — whose vocabularies are globally unified — every
+    process computes the identical value with no collective."""
     cl, cr = table.column(left), table.column(right)
     if left == right:
-        return cl.codes, cr.codes
+        return cl.codes, cr.codes, cl.domain_size
     vocab = {}
     for v in cl.vocab:
         vocab.setdefault(v, len(vocab))
@@ -166,7 +170,13 @@ def _shared_codes(table: EncodedTable, left: str, right: str) \
         out[valid] = m[codes[valid]]
         return out
 
-    return remap(cl.codes, map_l), remap(cr.codes, map_r)
+    return remap(cl.codes, map_l), remap(cr.codes, map_r), len(vocab)
+
+
+def _shared_codes(table: EncodedTable, left: str, right: str) \
+        -> Tuple[np.ndarray, np.ndarray]:
+    c1, c2, _ = _shared_codes_sized(table, left, right)
+    return c1, c2
 
 
 def _comparable_values(table: EncodedTable, attr: str) -> np.ndarray:
@@ -445,6 +455,95 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
     return out[:n_groups]
 
 
+# Entry budget for the dense global count tables the sharded DC evaluation
+# all-gathers (groups x values); constraints whose key/value product
+# exceeds it raise rather than silently materializing gigabytes per host.
+# The gather materializes a (P, entries) array before summing, so the
+# effective per-host ceiling divides by the process count.
+_SHARDED_DC_BUDGET = 1 << 27
+
+
+def _two_tuple_violations_sharded(table: EncodedTable,
+                                  preds: Sequence[Predicate]) -> np.ndarray:
+    """Two-tuple DC violations for PROCESS-LOCAL shards: the join keys are
+    DENSE in the globally-unified dictionaries, so the global group
+    statistics the host path computes with factorize/bincount become
+    allgather-sums (counts, per-group value tables) and allgather-maxes
+    (order extrema) of per-shard dense tables; each shard then flags its
+    own rows against the replicated statistics — the same shape as the
+    reference's distributed group-by jobs (ErrorDetectorApi.scala:213-231).
+    Supported residuals: none, one IQ, one LT/GT (the FD-style constraints
+    the workloads use); wider residual conjunctions and over-budget key
+    spaces raise."""
+    import jax
+
+    from delphi_tpu.parallel.distributed import allgather_max, allgather_sum
+
+    eq = [p for p in preds if p.sign == "EQ" and p.is_cross_tuple]
+    rest = [p for p in preds if not (p.sign == "EQ" and p.is_cross_tuple)]
+    n = table.n_rows
+    budget = _SHARDED_DC_BUDGET // max(jax.process_count(), 1)
+
+    g1 = np.zeros(n, dtype=np.int64)
+    g2 = np.zeros(n, dtype=np.int64)
+    n_groups = 1
+    for p in eq:
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+        c1, c2, size = _shared_codes_sized(table, p.left.name, p.right.name)
+        stride = size + 1  # +1: the NULL slot (codes+1 in [0, size])
+        if n_groups * stride > budget:
+            raise AnalysisException(
+                "constraint key space too wide for process-local "
+                f"evaluation ({n_groups * stride} > {budget}): "
+                f"{[str(q) for q in preds]}")
+        g1 = g1 * stride + (c1.astype(np.int64) + 1)
+        g2 = g2 * stride + (c2.astype(np.int64) + 1)
+        n_groups *= stride
+
+    if not rest:
+        counts = allgather_sum(np.bincount(g2, minlength=n_groups))
+        return counts[g1] > 0
+
+    if len(rest) == 1:
+        p = rest[0]
+        assert isinstance(p.left, AttrRef) and isinstance(p.right, AttrRef)
+        if p.sign == "IQ":
+            a1, a2, asize = _shared_codes_sized(
+                table, p.left.name, p.right.name)
+            width = asize + 1
+            if n_groups * width > budget:
+                raise AnalysisException(
+                    "constraint group x value table too wide for "
+                    f"process-local evaluation ({n_groups * width}): "
+                    f"{[str(q) for q in preds]}")
+            fused = g2 * width + (a2.astype(np.int64) + 1)
+            pair = allgather_sum(np.bincount(
+                fused, minlength=n_groups * width)).reshape(n_groups, width)
+            distinct = (pair > 0).sum(axis=1)
+            single = pair.argmax(axis=1)  # only read where distinct == 1
+            d1 = distinct[g1]
+            return (d1 >= 2) | ((d1 == 1) & (single[g1] != a1 + 1))
+        if p.sign in ("LT", "GT"):
+            v1 = _comparable_values(table, p.left.name)
+            v2 = _comparable_values(table, p.right.name)
+            valid2 = ~np.isnan(v2)
+            ext = np.full(n_groups, -np.inf)
+            if p.sign == "LT":
+                np.maximum.at(ext, g2[valid2], v2[valid2])
+                ext = allgather_max(ext)
+            else:
+                np.maximum.at(ext, g2[valid2], -v2[valid2])
+                ext = -allgather_max(ext)
+            bound = ext[g1]
+            with np.errstate(invalid="ignore"):
+                cmp = v1 < bound if p.sign == "LT" else v1 > bound
+            return np.where(np.isnan(v1) | np.isinf(bound), False, cmp)
+
+    raise AnalysisException(
+        "process-local constraint evaluation supports at most one IQ or "
+        f"order residual per constraint, but got: {[str(q) for q in preds]}")
+
+
 def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
         -> np.ndarray:
     """Left-tuple rows r1 with some r2 satisfying the conjunction.
@@ -453,6 +552,9 @@ def _two_tuple_violations(table: EncodedTable, preds: Sequence[Predicate]) \
     with per-group statistics when there is at most one of them, falling back
     to in-group pairwise evaluation otherwise.
     """
+    if getattr(table, "process_local", False):
+        return _two_tuple_violations_sharded(table, preds)
+
     eq = [p for p in preds if p.sign == "EQ" and p.is_cross_tuple]
     rest = [p for p in preds if not (p.sign == "EQ" and p.is_cross_tuple)]
     n = table.n_rows

@@ -260,7 +260,9 @@ except Exception:
     pass
 
 import pandas as pd
-from delphi_tpu import GaussianOutlierErrorDetector, NullErrorDetector, delphi
+from delphi_tpu import (
+    ConstraintErrorDetector, GaussianOutlierErrorDetector,
+    NullErrorDetector, delphi)
 from delphi_tpu.ingest import read_csv_encoded, read_csv_encoded_sharded
 
 if mode != "single":
@@ -281,7 +283,11 @@ else:
     assert table.n_rows < full_rows, table.n_rows
 
 delphi.register_table("shardtab", table)
-detectors = [NullErrorDetector(), GaussianOutlierErrorDetector()]
+detectors = [
+    NullErrorDetector(), GaussianOutlierErrorDetector(),
+    # FD-style DC: global group statistics reduce over the cluster
+    ConstraintErrorDetector(
+        constraints="t1&t2&EQ(t1.City,t2.City)&IQ(t1.State,t2.State)")]
 rep = delphi.repair \
     .setTableName("shardtab").setRowId("tid") \
     .setTargets(["City", "State", "County"]) \
