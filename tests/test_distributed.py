@@ -408,3 +408,51 @@ def test_two_process_sharded_pipeline(tmp_path):
     agree = (rep_s["repaired"].fillna("\0")
              == rep_m["repaired"].fillna("\0")).mean()
     assert agree >= 0.95, f"sharded repairs diverge: {agree:.2%}"
+
+
+def test_process_local_single_process_matches_normal(session):
+    """The ENTIRE process-local pipeline, degenerate single-process case:
+    every collective is the identity, so the sharded branches (global freq
+    kernels over the process mesh, presence-based class counts, gathered
+    training frames, round-robin training, sharded DC/outlier statistics)
+    must reproduce the normal path's repairs exactly."""
+    import dataclasses
+
+    import numpy as np
+    import pandas as pd
+
+    from delphi_tpu import (
+        ConstraintErrorDetector, GaussianOutlierErrorDetector,
+        NullErrorDetector, delphi)
+    from delphi_tpu.table import encode_table
+
+    rng = np.random.RandomState(21)
+    n = 260
+    city = rng.choice(["ba", "bb", "bc"], n)
+    state = np.where(city == "ba", "x", np.where(city == "bb", "y", "z"))
+    score = np.round(rng.randn(n) + 10.0, 2)
+    score[rng.choice(n, 3, replace=False)] = 555.0
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str), "City": city, "State": state,
+        "Score": score.astype("float64")})
+    df.loc[rng.choice(n, 25, replace=False), "State"] = None
+
+    detectors = [
+        NullErrorDetector(), GaussianOutlierErrorDetector(),
+        ConstraintErrorDetector(
+            constraints="t1&t2&EQ(t1.City,t2.City)&IQ(t1.State,t2.State)")]
+
+    def run(table):
+        delphi.register_table("pl_tab", table)
+        # Score must be a TARGET for the sharded outlier-fence path to run
+        # (detect_outliers covers continuous targets only)
+        return delphi.repair.setTableName("pl_tab").setRowId("tid") \
+            .setTargets(["City", "State", "Score"]) \
+            .setErrorDetectors(list(detectors)) \
+            .run().sort_values(["tid", "attribute"]).reset_index(drop=True)
+
+    normal_table = encode_table(df, "tid")
+    normal = run(normal_table)
+    sharded = run(dataclasses.replace(normal_table, process_local=True))
+    pd.testing.assert_frame_equal(sharded, normal)
+    assert len(normal) > 0
