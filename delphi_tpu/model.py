@@ -1892,8 +1892,9 @@ class RepairModel:
         # 2-3 run off the encoded int32 table, decoding only the sampled
         # training rows and the dirty-row block. This is what keeps the
         # 1e8-row single-host run inside memory.
-        masked = table.with_nulls_at(
-            list(zip(error_cells_df[ROW_IDX].astype(int), error_cells_df["attribute"])))
+        masked = table.with_nulls_at_arrays(
+            error_cells_df[ROW_IDX].to_numpy().astype(np.int64),
+            error_cells_df["attribute"].to_numpy(dtype=object))
         # dtype snapshot: an integral column that carries NULLs after masking
         # decodes to float64 in every downstream frame, even if rule repairs
         # later fill all of its NULLs (the old full-frame decode fixed dtypes

@@ -366,6 +366,12 @@ class PairDistinctCounter:
         cy = self._table.column(y)
         fused = (cx.codes.astype(np.int64) + 1) * (cy.domain_size + 1) \
             + (cy.codes.astype(np.int64) + 1)
+        dense = (cx.domain_size + 1) * (cy.domain_size + 1)
+        if dense <= 1 << 26:
+            # small value space: a dense bincount is pure indexed adds —
+            # measurably faster than factorize's hash pass at 1e8 rows,
+            # where this sweep is a top phase-1 cost
+            return int(np.count_nonzero(np.bincount(fused, minlength=dense)))
         # factorize = one hash pass; np.unique would sort
         return int(len(pd.factorize(fused)[1]))
 
