@@ -57,6 +57,20 @@ def _force_cpu_backend() -> None:
         pass
 
 
+def _run_report_path() -> str:
+    """Routes the measured run through the framework's run-report subsystem
+    (delphi_tpu/observability): if the caller didn't set DELPHI_METRICS_PATH,
+    point it at a temp file so the bench entry can embed the
+    framework-produced report (span tree + metrics + device attribution)."""
+    path = os.environ.get("DELPHI_METRICS_PATH")
+    if not path:
+        import tempfile
+        path = os.path.join(
+            tempfile.mkdtemp(prefix="delphi_report_"), "run_report.json")
+        os.environ["DELPHI_METRICS_PATH"] = path
+    return path
+
+
 def hospital_scale(scale: int, profile: bool = False) -> None:
     """North-star scale-out workload (BASELINE.json configs[4]): hospital
     rows replicated `scale` times, 3% of cells in three attrs nulled, full
@@ -103,6 +117,7 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
     jax.block_until_ready(jax.numpy.zeros(8).sum())
     _heartbeat("repair.run()")
 
+    report_path = _run_report_path()
     util = None
     if profile:
         from delphi_tpu.utils.profiling import DeviceUtilization
@@ -119,19 +134,21 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
 
     cells_per_sec = len(repaired) / elapsed if elapsed > 0 else 0.0
     extra = util.stop(elapsed) if util is not None else {}
-    print(json.dumps({
-        "metric": "hospital_scale_cells_repaired_per_sec",
-        "value": round(cells_per_sec, 1),
-        "unit": "cells/s",
-        "vs_baseline": None,
-        "scale": scale,
-        "rows": n_rows,
-        "repairs": int(len(repaired)),
-        "elapsed_s": round(elapsed, 3),
-        "device": device,
-        "peak_rss_gb": _peak_rss_gb(),
-        **extra,
-    }), flush=True)
+    from delphi_tpu.observability import bench_entry, load_run_report
+    print(json.dumps(bench_entry(
+        "hospital_scale_cells_repaired_per_sec",
+        round(cells_per_sec, 1), "cells/s",
+        extra={
+            "vs_baseline": None,
+            "scale": scale,
+            "rows": n_rows,
+            "repairs": int(len(repaired)),
+            "elapsed_s": round(elapsed, 3),
+            "device": device,
+            "peak_rss_gb": _peak_rss_gb(),
+            **extra,
+        },
+        run_report=load_run_report(report_path))), flush=True)
 
 
 def flights(scale: int, profile: bool = False) -> None:
@@ -178,6 +195,7 @@ def flights(scale: int, profile: bool = False) -> None:
     jax.block_until_ready(jax.numpy.zeros(8).sum())
     _heartbeat("repair.run()")
 
+    report_path = _run_report_path()
     util = None
     if profile:
         from delphi_tpu.utils.profiling import DeviceUtilization
@@ -193,18 +211,20 @@ def flights(scale: int, profile: bool = False) -> None:
         .run()
     elapsed = time.time() - t0
 
-    result = {
-        "metric": "flights_e2e_repair_wall_time",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(REFERENCE_SECONDS / elapsed, 3),
-        "scale": scale,
-        "rows": int(len(flights)),
-        "repairs": int(len(repaired)),
-        "cells_per_sec": round(len(repaired) / elapsed, 1) if elapsed else 0.0,
-        "device": device,
-        "peak_rss_gb": _peak_rss_gb(),
-    }
+    from delphi_tpu.observability import bench_entry, load_run_report
+    result = bench_entry(
+        "flights_e2e_repair_wall_time", round(elapsed, 3), "s",
+        extra={
+            "vs_baseline": round(REFERENCE_SECONDS / elapsed, 3),
+            "scale": scale,
+            "rows": int(len(flights)),
+            "repairs": int(len(repaired)),
+            "cells_per_sec": round(len(repaired) / elapsed, 1)
+            if elapsed else 0.0,
+            "device": device,
+            "peak_rss_gb": _peak_rss_gb(),
+        },
+        run_report=load_run_report(report_path))
     if util is not None:
         result.update(util.stop(elapsed))
 

@@ -29,6 +29,7 @@ from delphi_tpu.ops.entropy import compute_pairwise_stats, select_candidate_pair
 from delphi_tpu.ops.freq import FreqStats, PairDistinctCounter, compute_freq_stats
 from delphi_tpu.session import get_session
 from delphi_tpu.table import DiscretizedTable, EncodedTable, discretize_table
+from delphi_tpu.observability import counter_inc, gauge_set
 from delphi_tpu.utils import (
     get_option_value, job_phase, log_based_on_level, setup_logger, to_list_str)
 
@@ -593,6 +594,7 @@ class ErrorModel:
             noisy_columns = [c for c in table.column_names if c in union]
         return noisy_cells_df, noisy_columns
 
+    @job_phase(name="attr stats")
     def _compute_attr_stats(self, disc: DiscretizedTable, target_columns: List[str],
                             domain_stats: Dict[str, int]) \
             -> Tuple[FreqStats, Dict[str, List[Tuple[str, float]]]]:
@@ -604,6 +606,10 @@ class ErrorModel:
             target_columns, discretized_attrs, domain_stats,
             self._get_option_value(*self._opt_pairwise_freq_ratio_threshold),
             self._get_option_value(*self._opt_max_attrs_to_compute_pairwise_stats))
+        considered = len(target_columns) * (len(discretized_attrs) - 1)
+        gauge_set("stats.candidate_pairs", len(candidate_pairs))
+        counter_inc("stats.pairs_pruned",
+                    max(0, considered - len(candidate_pairs)))
 
         freq = compute_freq_stats(
             disc.table, discretized_attrs, candidate_pairs,
@@ -652,6 +658,8 @@ class ErrorModel:
         fixed = int(demote.sum())
         error_cells_df = noisy_cells_df[~demote].reset_index(drop=True)
         assert len(noisy_cells_df) == len(error_cells_df) + fixed
+        counter_inc("domain.cells_fixed", fixed)
+        gauge_set("domain.error_cells_remaining", len(error_cells_df))
         _logger.info(
             f"[Error Detection Phase] {fixed} noisy cells fixed and "
             f"{len(error_cells_df)} error cells remaining...")
@@ -662,6 +670,8 @@ class ErrorModel:
             -> Tuple[pd.DataFrame, List[str], Dict[str, Any], Dict[str, int]]:
         noisy_cells_df, noisy_columns = self._detect_errors(
             table, input_name, continuous_columns)
+        gauge_set("detect.noisy_cells", len(noisy_cells_df))
+        gauge_set("detect.noisy_columns", len(noisy_columns))
         total_cells = len(noisy_cells_df)
         if table.process_local:
             # a shard with zero local cells must still follow the global

@@ -17,6 +17,7 @@ import pandas as pd
 from delphi_tpu.table import (
     EncodedColumn, EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, KIND_STRING,
     column_kind, _value_strings)
+from delphi_tpu.observability import counter_inc
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -130,6 +131,8 @@ def encode_table_chunked(chunks: Iterable[pd.DataFrame],
         if row_id not in chunk.columns:
             from delphi_tpu.session import AnalysisException
             raise AnalysisException(f"Column '{row_id}' does not exist")
+        counter_inc("ingest.chunks")
+        counter_inc("ingest.rows", len(chunk))
         row_ids.append(chunk[row_id].to_numpy())
         if row_id_kind is None:
             row_id_kind = column_kind(chunk[row_id])

@@ -47,6 +47,12 @@ def main(argv=None) -> int:
                              "numeric; a column that mixes strings and "
                              "numbers across chunks fails loudly), 'str' "
                              "reads everything as strings")
+    parser.add_argument("--metrics-out", dest="metrics_out", type=str,
+                        default="",
+                        help="write a run-report JSON (span tree + metrics, "
+                             "see docs/source/observability.rst) to this "
+                             "path; equivalent to DELPHI_METRICS_PATH but "
+                             "also covers CSV ingestion")
     args = parser.parse_args(argv)
 
     # multi-host: join the cluster before any backend use (no-op when
@@ -55,6 +61,15 @@ def main(argv=None) -> int:
     maybe_initialize_distributed()
 
     session = get_session()
+    recorder = None
+    if args.metrics_out:
+        # The recorder opens here, before ingestion, so ingest.* metrics land
+        # in the report; the nested run() sees an active recorder, records
+        # into the same tree, and leaves report writing to this entry point.
+        from delphi_tpu import observability as obs
+        session.conf["repair.metrics.path"] = args.metrics_out
+        recorder = obs.start_recording(
+            "batch.main", events_path=obs.events_path_for(args.metrics_out))
     if args.input.endswith(".csv"):
         if args.chunksize > 0:
             from delphi_tpu.ingest import read_csv_encoded
@@ -86,8 +101,24 @@ def main(argv=None) -> int:
     if args.targets:
         model = model.setTargets(args.targets.split(","))
 
-    result = model.run(detect_errors_only=args.detect_only,
-                       repair_data=args.repair_data)
+    status, error = "ok", None
+    try:
+        result = model.run(detect_errors_only=args.detect_only,
+                           repair_data=args.repair_data)
+    except BaseException as e:
+        status, error = "error", f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        if recorder is not None:
+            from delphi_tpu import observability as obs
+            obs.stop_recording(recorder)
+            obs.write_run_report(
+                obs.build_run_report(
+                    recorder,
+                    run={"input": args.input, "output": args.output,
+                         "status": status},
+                    status=status, error=error),
+                args.metrics_out)
     result.to_csv(args.output, index=False)
     print(f"wrote {len(result)} rows to {args.output}", file=sys.stderr)
     return 0

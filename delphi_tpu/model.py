@@ -36,9 +36,10 @@ from delphi_tpu.table import (
     EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, check_input_table)
 from delphi_tpu.train import (
     build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
+from delphi_tpu.observability import counter_inc, gauge_set
 from delphi_tpu.utils import (
     argtype_check, elapsed_time, get_option_value, job_phase, log_based_on_level,
-    profile_trace, setup_logger, to_list_str)
+    phase_span, profile_trace, setup_logger, to_list_str)
 
 _logger = setup_logger()
 
@@ -1859,6 +1860,8 @@ class RepairModel:
             f"[Error Detection Phase] Detecting errors in a table `{input_name}`... ")
         error_cells_df, target_columns, pairwise_attr_stats, domain_stats = \
             self._detect_errors(table, input_name, continuous_columns)
+        gauge_set("pipeline.error_cells", int(len(error_cells_df)))
+        gauge_set("pipeline.target_columns", len(target_columns))
 
         if detect_errors_only:
             return error_cells_df.drop(columns=[ROW_IDX], errors="ignore")
@@ -1924,6 +1927,7 @@ class RepairModel:
 
         error_row_pos = np.unique(
             error_cells_df[ROW_IDX].to_numpy().astype(np.int64))
+        gauge_set("repair.dirty_rows", int(len(error_row_pos)))
 
         # checkpoint identity is content-hashed per process; process-local
         # shards would fingerprint (and race) P different hashes, so the
@@ -1937,6 +1941,15 @@ class RepairModel:
                 domain_stats, pairwise_attr_stats)
             if fingerprint:
                 self._save_model_checkpoint(models, fingerprint)
+        else:
+            counter_inc("train.checkpoint_hits")
+        for _, (model, _, _) in models:
+            if isinstance(model, PoorModel):
+                counter_inc("train.poor_models")
+            elif isinstance(model, FunctionalDepModel):
+                counter_inc("train.fd_rule_models")
+            else:
+                counter_inc("train.stat_models")
 
         #######################################################################
         # 3. Repair Phase
@@ -1967,6 +1980,7 @@ class RepairModel:
             pmf_parts: List[pd.DataFrame] = []
             score_parts: List[pd.DataFrame] = []
             for start in range(0, len(error_row_pos), chunk_rows):
+                counter_inc("repair.chunks")
                 pos = error_row_pos[start:start + chunk_rows]
                 # error_row_pos is sorted-unique, so a chunk's cells are
                 # exactly the cells in its row range
@@ -2001,6 +2015,7 @@ class RepairModel:
             parts = []
             ecf_rows = error_cells_df[ROW_IDX].to_numpy().astype(np.int64)
             for start in range(0, len(error_row_pos), chunk_rows):
+                counter_inc("repair.chunks")
                 pos = error_row_pos[start:start + chunk_rows]
                 dirty_chunk = masked.to_pandas(
                     rows=pos, integral_as_float=float_cols)
@@ -2020,6 +2035,7 @@ class RepairModel:
             # identical to the one-shot path's order
             return pd.concat(parts, ignore_index=True)
 
+        counter_inc("repair.chunks")
         dirty_rows_df = masked.to_pandas(
             rows=error_row_pos, integral_as_float=float_cols)
         repaired_rows_df = self._repair(
@@ -2163,7 +2179,51 @@ class RepairModel:
             repair_data: bool = False,
             maximal_likelihood_repair: bool = False) -> pd.DataFrame:
         """Runs the pipeline; flag semantics identical to the reference
-        (model.py:1421-1537)."""
+        (model.py:1421-1537).
+
+        When ``DELPHI_METRICS_PATH`` (or the ``repair.metrics.path`` session
+        config) is set, a versioned run-report JSON — span tree, metrics
+        registry snapshot, and (with ``DELPHI_PROFILE_DIR``) per-phase
+        device-time attribution — is written there when the run finishes,
+        whether it succeeds or fails (see delphi_tpu/observability)."""
+        from delphi_tpu import observability as obs
+
+        report_path = obs.metrics_path()
+        recorder = None
+        if report_path:
+            recorder = obs.start_recording(
+                "repair.run", events_path=obs.events_path_for(report_path))
+
+        status: str = "ok"
+        error: Optional[str] = None
+        run_info: Dict[str, Any] = {}
+        try:
+            return self._run_checked(
+                run_info, detect_errors_only, compute_repair_candidate_prob,
+                compute_repair_prob, compute_repair_score, repair_data,
+                maximal_likelihood_repair)
+        except BaseException as e:
+            status = "error"
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            if recorder is not None:
+                obs.stop_recording(recorder)
+                try:
+                    obs.write_run_report(
+                        obs.build_run_report(recorder, run=run_info,
+                                             status=status, error=error),
+                        report_path)
+                except Exception as e:
+                    # Reporting must never mask the run's own outcome.
+                    _logger.warning(f"failed to write run report: {e}")
+
+    def _run_checked(self, run_info: Dict[str, Any],
+                     detect_errors_only: bool,
+                     compute_repair_candidate_prob: bool,
+                     compute_repair_prob: bool, compute_repair_score: bool,
+                     repair_data: bool,
+                     maximal_likelihood_repair: bool) -> pd.DataFrame:
         if self.input is None or self.row_id is None:
             raise ValueError("`setInput` and `setRowId` should be called before repairing")
 
@@ -2205,18 +2265,28 @@ class RepairModel:
         if compute_repair_score:
             maximal_likelihood_repair = True
 
-        table, input_name, continuous_columns = self._check_input_table()
+        with phase_span("input validation"):
+            table, input_name, continuous_columns = self._check_input_table()
 
-        if maximal_likelihood_repair and len(continuous_columns) != 0:
-            raise ValueError(
-                "Cannot enable the maximal likelihood repair mode "
-                "when continous attributes found")
+            if maximal_likelihood_repair and len(continuous_columns) != 0:
+                raise ValueError(
+                    "Cannot enable the maximal likelihood repair mode "
+                    "when continous attributes found")
 
-        if self.targets and \
-                len(set(self.targets) & set(table.column_names)) == 0:
-            raise ValueError(
-                f"Target attributes not found in {input_name}: "
-                f"{to_list_str(self.targets)}")
+            if self.targets and \
+                    len(set(self.targets) & set(table.column_names)) == 0:
+                raise ValueError(
+                    f"Target attributes not found in {input_name}: "
+                    f"{to_list_str(self.targets)}")
+
+        gauge_set("pipeline.input_rows", table.n_rows)
+        gauge_set("pipeline.input_columns", len(table.columns))
+        run_info.update({
+            "input_table": input_name,
+            "n_rows": int(table.n_rows),
+            "n_columns": len(table.columns),
+            "mode": (selected[0] if selected else "repair_candidates"),
+        })
 
         with profile_trace("delphi.repair.run"):
             df, elapsed = self._run(
@@ -2224,6 +2294,8 @@ class RepairModel:
                 compute_repair_candidate_prob, compute_repair_prob,
                 compute_repair_score, repair_data, maximal_likelihood_repair)
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
+        run_info["elapsed_s"] = round(elapsed, 6)
+        run_info["result_rows"] = int(len(df))
         return df
 
 

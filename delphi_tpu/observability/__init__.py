@@ -1,0 +1,52 @@
+"""Unified observability for the repair pipeline.
+
+Three pieces (see docs/source/observability.rst):
+
+* :mod:`~delphi_tpu.observability.registry` — process-wide metrics registry
+  (counters / gauges / histograms). Instrumentation calls the module-level
+  helpers re-exported here; they no-op when no run recorder is active.
+* :mod:`~delphi_tpu.observability.spans` — hierarchical span tree recorded
+  by ``phase_span`` plus the run-scoped :class:`RunRecorder`.
+* :mod:`~delphi_tpu.observability.report` — the versioned run-report JSON
+  written at the end of ``RepairModel.run()`` when ``DELPHI_METRICS_PATH``
+  or the ``repair.metrics.path`` session config is set, including per-phase
+  device-time attribution when a profiler trace was captured.
+"""
+
+import os
+from typing import Optional
+
+from delphi_tpu.observability.registry import (  # noqa: F401
+    MetricsRegistry, counter_inc, gauge_max, gauge_set, histogram_observe,
+)
+from delphi_tpu.observability.report import (  # noqa: F401
+    REPORT_KIND, REPORT_SCHEMA_VERSION, attribute_device_time, bench_entry,
+    build_run_report, load_run_report, write_run_report,
+)
+from delphi_tpu.observability.spans import (  # noqa: F401
+    RunRecorder, Span, current_recorder, start_recording, stop_recording,
+)
+
+
+def metrics_path() -> Optional[str]:
+    """The configured run-report destination, or ``None`` when observability
+    is disabled (`DELPHI_METRICS_PATH` env wins over the
+    ``repair.metrics.path`` session config)."""
+    path = os.environ.get("DELPHI_METRICS_PATH")
+    if path:
+        return path
+    from delphi_tpu.session import get_session
+
+    return get_session().conf.get("repair.metrics.path") or None
+
+
+def events_path_for(path: str) -> Optional[str]:
+    """JSONL event-stream destination next to the report, enabled by
+    ``DELPHI_METRICS_EVENTS=1`` or ``repair.metrics.events=true``."""
+    if os.environ.get("DELPHI_METRICS_EVENTS") == "1":
+        return path + ".events.jsonl"
+    from delphi_tpu.session import get_session
+
+    if get_session().conf.get("repair.metrics.events", "").lower() == "true":
+        return path + ".events.jsonl"
+    return None

@@ -1,0 +1,136 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The registry itself is a plain locked dict-of-numbers container owned by the
+active :class:`~delphi_tpu.observability.spans.RunRecorder`. The module-level
+helpers (:func:`counter_inc` & co.) are what instrumented pipeline code calls;
+they no-op with a single global ``is None`` check when no run recorder is
+active (i.e. neither ``DELPHI_METRICS_PATH`` nor ``repair.metrics.path`` is
+set), so always-on instrumentation costs nothing on the default path.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+Number = Union[int, float]
+
+# How many raw observations a histogram keeps for percentile estimation.
+# Beyond this the count/sum/min/max stay exact but p50/p95 are computed from
+# the first _HIST_SAMPLE_CAP values only.
+_HIST_SAMPLE_CAP = 512
+
+
+class _Histogram:
+    __slots__ = ("count", "total", "min", "max", "samples")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.samples: List[float] = []
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        if len(self.samples) < _HIST_SAMPLE_CAP:
+            self.samples.append(value)
+
+    def summary(self) -> Dict[str, Any]:
+        s = sorted(self.samples)
+
+        def pct(q: float) -> Optional[float]:
+            if not s:
+                return None
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.total / self.count) if self.count else None,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named counters/gauges/histograms with a JSON snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, _Histogram] = {}
+
+    def inc(self, name: str, value: Number = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: Number) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def max_gauge(self, name: str, value: Number) -> None:
+        """Keeps the maximum value seen — e.g. peak per-chunk row counts."""
+        with self._lock:
+            prev = self._gauges.get(name)
+            self._gauges[name] = value if prev is None else max(prev, value)
+
+    def observe(self, name: str, value: Number) -> None:
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = self._histograms[name] = _Histogram()
+            hist.observe(float(value))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(sorted(self._counters.items())),
+                "gauges": dict(sorted(self._gauges.items())),
+                "histograms": {k: v.summary() for k, v
+                               in sorted(self._histograms.items())},
+            }
+
+
+# Cached reference to the spans module, resolved on first use. Importing
+# lazily avoids a registry<->spans import cycle; caching keeps the disabled
+# fast path to an attribute load + `is None` check.
+_spans_mod = None
+
+
+def _active_registry() -> Optional[MetricsRegistry]:
+    global _spans_mod
+    if _spans_mod is None:
+        from delphi_tpu.observability import spans
+        _spans_mod = spans
+
+    rec = _spans_mod._current
+    return rec.registry if rec is not None else None
+
+
+def counter_inc(name: str, value: Number = 1) -> None:
+    reg = _active_registry()
+    if reg is not None:
+        reg.inc(name, value)
+
+
+def gauge_set(name: str, value: Number) -> None:
+    reg = _active_registry()
+    if reg is not None:
+        reg.set_gauge(name, value)
+
+
+def gauge_max(name: str, value: Number) -> None:
+    reg = _active_registry()
+    if reg is not None:
+        reg.max_gauge(name, value)
+
+
+def histogram_observe(name: str, value: Number) -> None:
+    reg = _active_registry()
+    if reg is not None:
+        reg.observe(name, value)

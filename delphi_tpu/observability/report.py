@@ -1,0 +1,277 @@
+"""Structured run report: versioned JSON emitted at the end of
+``RepairModel.run()`` (and by ``bench.py``) when ``DELPHI_METRICS_PATH`` /
+``repair.metrics.path`` is set.
+
+Schema (version 1)::
+
+    {
+      "schema_version": 1,
+      "kind": "delphi_tpu.run_report",
+      "created_at": "<ISO-8601 UTC>",
+      "status": "ok" | "error",
+      "error": "<message>",                  # only when status == "error"
+      "run":   {...},                        # caller-supplied run facts
+      "env":   {backend, devices, versions},
+      "metrics": {"counters": {}, "gauges": {}, "histograms": {}},
+      "spans": {name, start_s, wall_s, [device_s], children: [...]},
+      "device_time": {trace_dir, device_busy_s, per_phase: {}} | null
+    }
+
+Device-time attribution joins the xplane parser in
+``delphi_tpu/utils/profiling.py`` against the ``TraceAnnotation`` ranges that
+``phase_span`` opens: annotation events (host-side, named after the span)
+define per-phase time windows, and device execution-line events overlapping
+those windows are credited to the phase.
+"""
+
+import json
+import os
+import tempfile
+from datetime import datetime, timezone
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+REPORT_SCHEMA_VERSION = 1
+REPORT_KIND = "delphi_tpu.run_report"
+
+Interval = Tuple[int, int]
+
+
+def _merge_intervals(intervals: List[Interval]) -> List[Interval]:
+    merged: List[Interval] = []
+    for s, e in sorted(intervals):
+        if merged and s <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((s, e))
+    return merged
+
+
+def _overlap_ns(a: List[Interval], b: List[Interval]) -> int:
+    """Total overlap between two sorted, merged interval lists."""
+    total = 0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _event_interval(line: Any, ev: Any) -> Interval:
+    start = line.timestamp_ns + ev.offset_ps // 1000
+    return (start, start + ev.duration_ps // 1000)
+
+
+def _annotation_windows(spaces: List[Any],
+                        names: Iterable[str]) -> Dict[str, List[Interval]]:
+    """Per-span-name merged time windows from `TraceAnnotation` events.
+
+    Annotations are recorded host-side, so every plane and line is scanned
+    (unlike device busy time, which only looks at XLA execution lines)."""
+    wanted = set(names)
+    windows: Dict[str, List[Interval]] = {}
+    for xs in spaces:
+        for plane in xs.planes:
+            meta = {m.id: m.name for m in plane.event_metadata.values()} \
+                if hasattr(plane.event_metadata, "values") else \
+                {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id)
+                    if name in wanted:
+                        windows.setdefault(name, []).append(
+                            _event_interval(line, ev))
+    return {name: _merge_intervals(iv) for name, iv in windows.items()}
+
+
+def _device_exec_intervals(spaces: List[Any]) -> List[Interval]:
+    from delphi_tpu.utils.profiling import _device_planes, _exec_lines
+
+    intervals: List[Interval] = []
+    for plane in _device_planes(spaces):
+        for line in _exec_lines(plane):
+            for ev in line.events:
+                intervals.append(_event_interval(line, ev))
+    return _merge_intervals(intervals)
+
+
+def attribute_device_time(trace_dir: str, span_names: Iterable[str]) \
+        -> Optional[Dict[str, Any]]:
+    """Joins a captured profiler trace against span names.
+
+    Returns ``{"device_busy_s": float, "per_phase": {name: seconds}}`` or
+    ``None`` when the trace is unreadable/empty (missing proto deps, no
+    xplane files, no annotation events)."""
+    try:
+        from delphi_tpu.utils.profiling import _load_xspaces
+
+        spaces = _load_xspaces(trace_dir)
+    except Exception as e:
+        _logger.warning(f"cannot parse profiler trace in {trace_dir}: {e}")
+        return None
+    if not spaces:
+        return None
+    device = _device_exec_intervals(spaces)
+    windows = _annotation_windows(spaces, span_names)
+    if not device or not windows:
+        return None
+    per_phase = {name: round(_overlap_ns(device, iv) / 1e9, 6)
+                 for name, iv in sorted(windows.items())}
+    busy_ns = sum(e - s for s, e in device)
+    return {"device_busy_s": round(busy_ns / 1e9, 6), "per_phase": per_phase}
+
+
+def _peak_rss_gb() -> Optional[float]:
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmHWM:"):
+                    return round(int(ln.split()[1]) / 1024 / 1024, 3)
+    except Exception:
+        pass
+    return None
+
+
+def _env_info() -> Dict[str, Any]:
+    import platform
+
+    info: Dict[str, Any] = {
+        "python_version": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        info.update({
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "device_kind": devices[0].device_kind if devices else None,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        })
+    except Exception as e:
+        info["jax_error"] = f"{type(e).__name__}: {e}"
+    return info
+
+
+def _record_memory_gauges(registry: Any) -> None:
+    """Peak RSS + jax device-memory gauges, sampled at report time."""
+    rss = _peak_rss_gb()
+    if rss is not None:
+        registry.set_gauge("system.peak_rss_gb", rss)
+    try:
+        import jax
+
+        in_use = peak = 0
+        seen = False
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            seen = True
+            in_use += stats.get("bytes_in_use", 0)
+            peak += stats.get("peak_bytes_in_use", 0)
+        if seen:
+            registry.set_gauge("device.bytes_in_use", in_use)
+            registry.set_gauge("device.peak_bytes_in_use", peak)
+    except Exception:
+        pass
+
+
+def build_run_report(recorder: Any,
+                     run: Optional[Dict[str, Any]] = None,
+                     status: str = "ok",
+                     error: Optional[str] = None) -> Dict[str, Any]:
+    """Assembles the versioned report dict from a finished recorder."""
+    _record_memory_gauges(recorder.registry)
+
+    root = recorder.root
+    device_time = None
+    if recorder.trace_dir:
+        names = {s.name for s in root.walk() if s is not root}
+        device_time = attribute_device_time(recorder.trace_dir, names)
+        if device_time is not None:
+            device_time["trace_dir"] = recorder.trace_dir
+            per_phase = device_time["per_phase"]
+            # Annotate span nodes in place; a name repeated across the tree
+            # (e.g. chunked repair passes) only gets the per-phase total in
+            # `device_time`, since windows for same-named spans are merged.
+            counts: Dict[str, int] = {}
+            for s in root.walk():
+                counts[s.name] = counts.get(s.name, 0) + 1
+            for s in root.walk():
+                if counts.get(s.name) == 1 and s.name in per_phase:
+                    s.device_s = per_phase[s.name]
+
+    return {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "kind": REPORT_KIND,
+        "created_at": datetime.fromtimestamp(
+            recorder.started_at, tz=timezone.utc).isoformat(),
+        "status": status,
+        **({"error": error} if error else {}),
+        "run": run or {},
+        "env": _env_info(),
+        "metrics": recorder.registry.snapshot(),
+        "spans": root.to_dict(),
+        "device_time": device_time,
+    }
+
+
+def write_run_report(report: Dict[str, Any], path: str) -> None:
+    """Atomic-rename write so readers never see a torn report."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".run_report_", dir=directory)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _logger.info(f"Run report written to {path}")
+
+
+def load_run_report(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception as e:
+        _logger.warning(f"cannot load run report {path}: {e}")
+        return None
+
+
+def bench_entry(metric: str, value: Any, unit: str,
+                extra: Optional[Dict[str, Any]] = None,
+                run_report: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One BENCH_r*.json result line, produced by the framework so bench
+    entries and run reports share a schema version."""
+    entry: Dict[str, Any] = {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "kind": "delphi_tpu.bench_entry",
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+    }
+    if extra:
+        entry.update(extra)
+    if run_report is not None:
+        entry["run_report"] = run_report
+    return entry

@@ -1,0 +1,188 @@
+"""Hierarchical span tree + run-scoped recorder.
+
+`phase_span` (delphi_tpu/utils) calls :func:`span_enter`/:func:`span_exit`
+on every phase. When no recorder is active both are a single ``is None``
+check; when one is (``DELPHI_METRICS_PATH`` / ``repair.metrics.path``), each
+phase becomes a node in a tree rooted at the run, carrying its start offset
+and wall time, and optionally an event line in a JSONL stream.
+
+Span stacks are thread-local: a span opened on a worker thread whose stack
+is empty attaches to the run root rather than to whatever span happens to be
+open on another thread — per-thread structure stays honest.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, IO, List, Optional
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+
+class Span:
+    __slots__ = ("name", "start_s", "wall_s", "children", "failed",
+                 "device_s", "thread", "_t0", "_rec")
+
+    def __init__(self, name: str, start_s: float) -> None:
+        self.name = name
+        self.start_s = start_s
+        self.wall_s = 0.0
+        self.children: List["Span"] = []
+        self.failed = False
+        self.device_s: Optional[float] = None
+        self.thread: Optional[str] = None
+        self._t0 = 0.0
+        self._rec: Optional["RunRecorder"] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "wall_s": round(self.wall_s, 6),
+        }
+        if self.device_s is not None:
+            d["device_s"] = round(self.device_s, 6)
+        if self.failed:
+            d["failed"] = True
+        if self.thread:
+            d["thread"] = self.thread
+        d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+class RunRecorder:
+    """Collects the span tree, metrics registry, and optional JSONL event
+    stream for one ``RepairModel.run()`` invocation."""
+
+    def __init__(self, name: str,
+                 events_path: Optional[str] = None) -> None:
+        from delphi_tpu.observability.registry import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.started_at = time.time()
+        self._t0 = time.perf_counter()
+        self.root = Span(name, 0.0)
+        self.root._t0 = self._t0
+        self.root._rec = self
+        # Filled in by profile_trace when a trace is captured during the run,
+        # so the report builder knows where to find xplane files to join.
+        self.trace_dir: Optional[str] = None
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._events_fh: Optional[IO[str]] = None
+        if events_path:
+            try:
+                parent = os.path.dirname(os.path.abspath(events_path))
+                os.makedirs(parent, exist_ok=True)
+                self._events_fh = open(events_path, "w")
+            except OSError as e:
+                _logger.warning(f"cannot open event stream {events_path}: {e}")
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def span_enter(self, name: str) -> Span:
+        now = time.perf_counter()
+        span = Span(name, now - self._t0)
+        span._t0 = now
+        span._rec = self
+        thread = threading.current_thread()
+        if thread is not threading.main_thread():
+            span.thread = thread.name
+        self._stack().append(span)
+        self.emit_event({"event": "span_enter", "name": name,
+                         "t_s": round(span.start_s, 6)})
+        return span
+
+    def span_exit(self, span: Span, failed: bool = False) -> None:
+        span.wall_s = time.perf_counter() - span._t0
+        span.failed = failed
+        stack = self._stack()
+        if span in stack:
+            # Pop through any spans left open by exceptions below this one.
+            while stack and stack[-1] is not span:
+                stack.pop()
+            stack.pop()
+        parent = stack[-1] if stack else self.root
+        with self._lock:
+            parent.children.append(span)
+        self.emit_event({"event": "span_exit", "name": span.name,
+                         "wall_s": round(span.wall_s, 6),
+                         "failed": failed})
+
+    def finish(self) -> None:
+        self.root.wall_s = time.perf_counter() - self.root._t0
+
+    def emit_event(self, payload: Dict[str, Any]) -> None:
+        fh = self._events_fh
+        if fh is None:
+            return
+        try:
+            with self._lock:
+                fh.write(json.dumps(payload) + "\n")
+                fh.flush()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self._events_fh is not None:
+            try:
+                self._events_fh.close()
+            except Exception:
+                pass
+            self._events_fh = None
+
+
+# The process-wide active recorder. Written only by start/stop_recording;
+# instrumentation reads it with a single attribute load.
+_current: Optional[RunRecorder] = None
+
+
+def current_recorder() -> Optional[RunRecorder]:
+    return _current
+
+
+def start_recording(name: str,
+                    events_path: Optional[str] = None) -> Optional[RunRecorder]:
+    """Activates a run recorder, unless one is already active (a nested
+    ``run()`` then records into the outer run's tree and returns ``None`` so
+    only the outer caller writes a report)."""
+    global _current
+    if _current is not None:
+        return None
+    _current = RunRecorder(name, events_path=events_path)
+    return _current
+
+
+def stop_recording(recorder: Optional[RunRecorder]) -> None:
+    global _current
+    if recorder is None:
+        return
+    recorder.finish()
+    recorder.close()
+    if _current is recorder:
+        _current = None
+
+
+def span_enter(name: str) -> Optional[Span]:
+    rec = _current
+    return rec.span_enter(name) if rec is not None else None
+
+
+def span_exit(span: Optional[Span], failed: bool = False) -> None:
+    if span is not None and span._rec is not None:
+        span._rec.span_exit(span, failed=failed)

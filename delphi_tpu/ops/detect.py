@@ -26,6 +26,7 @@ import numpy as np
 from delphi_tpu.constraints import AttrRef, Constant, DenialConstraints, Predicate
 from delphi_tpu.session import AnalysisException
 from delphi_tpu.table import EncodedTable, NULL_CODE
+from delphi_tpu.observability import counter_inc
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -38,8 +39,10 @@ def detect_null_cells(table: EncodedTable, target_attrs: Sequence[str]) \
     out = []
     for name in table.column_names:
         if name in target_attrs:
+            counter_inc("detect.cells_scanned", table.n_rows)
             rows = np.nonzero(table.column(name).null_mask())[0]
             if rows.size:
+                counter_inc("detect.null_cells", rows.size)
                 out.append((rows, name))
     return out
 
@@ -63,6 +66,8 @@ def detect_regex_errors(table: EncodedTable, attr: str, regex: str,
     valid = col.codes != NULL_CODE
     ok[valid] = vocab_ok[col.codes[valid]]
     rows = np.nonzero(~ok)[0]  # non-matching values OR NULLs
+    counter_inc("detect.cells_scanned", table.n_rows)
+    counter_inc("detect.regex_cells", rows.size)
     return [(rows, attr)] if rows.size else []
 
 
@@ -140,8 +145,10 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
         upper = q3 + 1.5 * (q3 - q1)
         _logger.info(f"Non-outlier values in {attr} should be in [{lower}, {upper}]")
         bad = valid & ((values < lower) | (values > upper))
+        counter_inc("detect.cells_scanned", table.n_rows)
         rows = np.nonzero(bad)[0]
         if rows.size:
+            counter_inc("detect.outlier_cells", rows.size)
             out.append((rows, attr))
     return out
 
@@ -837,8 +844,10 @@ def detect_constraint_violations(table: EncodedTable,
             mask = _one_tuple_violations(table, preds)
         else:
             mask = _two_tuple_violations(table, preds)
+        counter_inc("detect.cells_scanned", table.n_rows * len(attrs))
         rows = np.nonzero(mask)[0]
         if rows.size:
+            counter_inc("detect.constraint_cells", rows.size * len(attrs))
             for a in attrs:
                 out.append((rows, a))
     return out

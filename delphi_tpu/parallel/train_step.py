@@ -22,12 +22,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from delphi_tpu.observability import counter_inc
 from delphi_tpu.parallel.mesh import shard_map
 
 
 def logreg_train_step(mesh: Mesh, lr: float = 0.1, l2: float = 1e-4):
     """Returns a jitted (W, b, X, y) -> (W, b, loss) SGD step with
     X: P('dp', None), y: P('dp'), W: P(None, 'tp'), b: P('tp')."""
+    counter_inc("parallel.logreg_step_programs")
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None, "tp"), P("tp"), P("dp", None), P("dp")),
@@ -68,6 +70,7 @@ def gbdt_histogram_round(mesh: Mesh, depth: int, n_bins: int,
     it to its local rows; outputs are replicated tree arrays plus the
     row-sharded prediction delta.
     """
+    counter_inc("parallel.gbdt_round_programs")
     n_nodes = 1 << depth
 
     @partial(shard_map, mesh=mesh,

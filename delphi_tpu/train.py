@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from delphi_tpu.observability import counter_inc, histogram_observe
 from delphi_tpu.utils import elapsed_time, get_option_value, setup_logger
 
 _logger = setup_logger()
@@ -339,7 +340,10 @@ def build_model(X: np.ndarray, y: pd.Series, is_discrete: bool, num_class: int,
                 n_jobs: int, opts: Dict[str, str]) -> Tuple[Tuple[Any, float], float]:
     """Returns ((model, score), elapsed_seconds); model is None on failure
     (callers substitute PoorModel, reference train.py:227-229)."""
-    return _build_jax_model(X, y, is_discrete, num_class, n_jobs, opts)
+    out = _build_jax_model(X, y, is_discrete, num_class, n_jobs, opts)
+    counter_inc("train.model_builds")
+    histogram_observe("train.model_build_seconds", out[1])
+    return out
 
 
 def _trimmed_grid(is_discrete: bool, num_class: int, max_evals: int,
@@ -406,6 +410,7 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
         def opt(*args):  # type: ignore
             return get_option_value(opts, *args)
 
+        counter_inc("train.batched_gbdt_targets", len(gbdt_tasks))
         n_splits = int(opt(*_opt_n_splits))
         max_evals = int(opt(*_opt_max_evals))
         class_weight = str(opt(*_opt_class_weight))

@@ -12,6 +12,7 @@ import inspect
 import itertools
 import logging
 import os
+import threading
 import time
 import typing
 from typing import Any, Dict, List, Optional
@@ -20,8 +21,27 @@ _LOGGER_NAME = "delphi_tpu"
 
 
 def setup_logger() -> logging.Logger:
+    """Returns the library logger. By default only a ``NullHandler`` is
+    attached (the embedding application owns handler policy); setting
+    ``DELPHI_LOG_LEVEL`` (e.g. ``INFO``, ``DEBUG``) installs a single
+    timestamped stderr handler at that level, so library narration is
+    visible outside pytest without any logging.basicConfig boilerplate."""
     logger = logging.getLogger(_LOGGER_NAME)
     logger.setLevel(logging.INFO)
+    level_name = os.environ.get("DELPHI_LOG_LEVEL")
+    if level_name:
+        level = logging.getLevelName(level_name.strip().upper())
+        if isinstance(level, int):
+            logger.setLevel(level)
+        else:
+            logger.warning(f"Unknown DELPHI_LOG_LEVEL: {level_name}")
+        if not any(getattr(h, "_delphi_stderr", False)
+                   for h in logger.handlers):
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+            handler._delphi_stderr = True  # type: ignore[attr-defined]
+            logger.addHandler(handler)
     if not logger.handlers:
         logger.addHandler(logging.NullHandler())
     return logger
@@ -144,9 +164,11 @@ def elapsed_time(f):  # type: ignore
 
     @functools.wraps(f)
     def wrapper(self, *args, **kwargs):  # type: ignore
-        start = time.time()
+        # perf_counter, not time.time(): wall-clock is subject to NTP steps,
+        # which would corrupt the phase timings these numbers feed.
+        start = time.perf_counter()
         ret = f(self, *args, **kwargs)
-        return ret, time.time() - start
+        return ret, time.perf_counter() - start
 
     return wrapper
 
@@ -193,34 +215,55 @@ class phase_span:
     :func:`job_phase`. Each span additionally opens a
     ``jax.profiler.TraceAnnotation`` so phases show up as named ranges in
     XLA profiler traces captured via :func:`profile_trace` (the TPU-native
-    replacement for phases being visible in the Spark UI)."""
+    replacement for phases being visible in the Spark UI), and — when a run
+    recorder is active (``DELPHI_METRICS_PATH`` / ``repair.metrics.path``) —
+    records itself into the hierarchical span tree of the run report
+    (:mod:`delphi_tpu.observability`)."""
 
-    _active: List[str] = []
+    # The active-span stack is thread-local: batched-training worker threads
+    # open concurrent spans, and a shared class-level list would interleave
+    # their heartbeat paths and pop entries belonging to other threads.
+    _tls = threading.local()
+
+    @classmethod
+    def _stack(cls) -> List[str]:
+        stack = getattr(cls._tls, "stack", None)
+        if stack is None:
+            stack = cls._tls.stack = []
+        return stack
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._t0 = 0.0
         self._annotation: Any = None
+        self._span: Any = None
 
     def __enter__(self) -> "phase_span":
-        phase_span._active.append(self.name)
-        _phase_heartbeat(">>", "/".join(phase_span._active))
+        stack = phase_span._stack()
+        stack.append(self.name)
+        _phase_heartbeat(">>", "/".join(stack))
         try:
             import jax.profiler
             self._annotation = jax.profiler.TraceAnnotation(self.name)
             self._annotation.__enter__()
         except Exception:
             self._annotation = None
-        self._t0 = time.time()
+        from delphi_tpu.observability import spans as _obs_spans
+        self._span = _obs_spans.span_enter(self.name)
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc: Any) -> None:
         if self._annotation is not None:
             self._annotation.__exit__(None, None, None)
-        elapsed = time.time() - self._t0
-        _phase_heartbeat("<<", f"{'/'.join(phase_span._active)} "
+        elapsed = time.perf_counter() - self._t0
+        if self._span is not None:
+            from delphi_tpu.observability import spans as _obs_spans
+            _obs_spans.span_exit(self._span, failed=exc[0] is not None)
+        stack = phase_span._stack()
+        _phase_heartbeat("<<", f"{'/'.join(stack)} "
                                f"({elapsed:.1f}s)")
-        phase_span._active.pop()
+        stack.pop()
         _logger.info(f"Elapsed time (name: {self.name}) is {elapsed}(s)")
 
 
@@ -259,6 +302,12 @@ class profile_trace:
                 jax.profiler.stop_trace()
                 _logger.info(
                     f"Profiler trace (name: {self.name}) written to {self._dir}")
+                from delphi_tpu.observability import spans as _obs_spans
+                recorder = _obs_spans.current_recorder()
+                if recorder is not None:
+                    # Let the run report join phase annotations against this
+                    # trace for per-phase device-time attribution.
+                    recorder.trace_dir = self._dir
             except Exception as e:
                 # Never let a trace-flush failure fail (or mask an exception
                 # from) the profiled run itself.

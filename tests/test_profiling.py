@@ -1,0 +1,149 @@
+"""Tests for the xplane trace parser (`delphi_tpu/utils/profiling.py`) and
+the run-report device-time attribution built on it
+(`delphi_tpu/observability/report.py`), against synthetic `XSpace` protos —
+no profiler run needed."""
+
+import pytest
+
+xplane_pb2 = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+from delphi_tpu.observability.report import (
+    _annotation_windows, _merge_intervals, _overlap_ns, attribute_device_time)
+from delphi_tpu.utils.profiling import (
+    _busy_and_top_ops, _device_planes, _exec_lines)
+
+MS = 1_000_000  # ns per millisecond
+
+
+def _add_plane(space, name, lines):
+    """lines: [(line_name, timestamp_ns, [(op_name, offset_ns, dur_ns)])]"""
+    plane = space.planes.add()
+    plane.name = name
+    meta_ids = {}
+    for line_name, ts, events in lines:
+        line = plane.lines.add()
+        line.name = line_name
+        line.timestamp_ns = ts
+        for op, off, dur in events:
+            if op not in meta_ids:
+                mid = len(meta_ids) + 1
+                meta_ids[op] = mid
+                meta = plane.event_metadata[mid]
+                meta.id = mid
+                meta.name = op
+            ev = line.events.add()
+            ev.metadata_id = meta_ids[op]
+            ev.offset_ps = off * 1000
+            ev.duration_ps = dur * 1000
+    return plane
+
+
+def test_device_planes_prefer_accelerator():
+    space = xplane_pb2.XSpace()
+    _add_plane(space, "/device:TPU:0 (pid 1)", [])
+    _add_plane(space, "/host:CPU (pid 2)", [])
+    planes = _device_planes([space])
+    assert [p.name for p in planes] == ["/device:TPU:0 (pid 1)"]
+
+
+def test_device_planes_fall_back_to_host():
+    space = xplane_pb2.XSpace()
+    _add_plane(space, "/host:CPU (pid 2)", [])
+    _add_plane(space, "some other plane", [])
+    planes = _device_planes([space])
+    assert [p.name for p in planes] == ["/host:CPU (pid 2)"]
+
+
+def test_exec_lines_prefer_per_op_over_module():
+    space = xplane_pb2.XSpace()
+    plane = _add_plane(space, "/device:TPU:0", [
+        ("python", 0, []),
+        ("XLA Modules", 0, []),
+        ("XLA Ops", 0, []),
+    ])
+    assert [ln.name for ln in _exec_lines(plane)] == ["XLA Ops"]
+
+
+def test_exec_lines_drop_python_keep_rest():
+    space = xplane_pb2.XSpace()
+    plane = _add_plane(space, "/host:CPU", [
+        ("python", 0, []),
+        ("Steps", 0, []),
+        ("TensorFlow Ops", 0, []),
+    ])
+    assert [ln.name for ln in _exec_lines(plane)] == \
+        ["Steps", "TensorFlow Ops"]
+
+
+def test_busy_fraction_unions_overlapping_events():
+    space = xplane_pb2.XSpace()
+    plane = _add_plane(space, "/device:TPU:0", [
+        # [0,10ms] and [5,15ms] overlap -> 15ms busy; [20,25ms] adds 5ms
+        ("XLA Ops", 0, [("fusion.1", 0, 10 * MS),
+                        ("fusion.2", 5 * MS, 10 * MS),
+                        ("fusion.1", 20 * MS, 5 * MS)]),
+    ])
+    busy_s, top = _busy_and_top_ops([plane])
+    assert busy_s == pytest.approx(0.020)
+    # per-op totals are NOT unioned: fusion.1 = 15ms, fusion.2 = 10ms
+    assert top[0] == ("fusion.1", pytest.approx(0.015))
+    assert top[1] == ("fusion.2", pytest.approx(0.010))
+
+
+def test_busy_time_respects_line_timestamp_offset():
+    space = xplane_pb2.XSpace()
+    plane = _add_plane(space, "/device:TPU:0", [
+        # two lines with different base timestamps; events abut in absolute
+        # time ([10,12ms] and [12,14ms]) -> one merged 4ms interval
+        ("XLA Ops", 10 * MS, [("a", 0, 2 * MS)]),
+        ("XLA Ops#2", 12 * MS, [("b", 0, 2 * MS)]),
+    ])
+    busy_s, _ = _busy_and_top_ops([plane])
+    assert busy_s == pytest.approx(0.004)
+
+
+def test_interval_helpers():
+    assert _merge_intervals([(5, 7), (0, 3), (2, 4)]) == [(0, 4), (5, 7)]
+    assert _overlap_ns([(0, 10), (20, 30)], [(5, 25)]) == 10
+
+
+def _attribution_space():
+    """Host plane carries phase annotations; device plane carries XLA ops.
+
+    phase-a window [0,10ms] covers device events [2,4] and [6,8] -> 4ms.
+    phase-b window [10,20ms] covers device event [12,14] -> 2ms.
+    """
+    space = xplane_pb2.XSpace()
+    _add_plane(space, "/host:CPU (pid 1)", [
+        ("python", 0, [("phase-a", 0, 10 * MS),
+                       ("phase-b", 10 * MS, 10 * MS)]),
+    ])
+    _add_plane(space, "/device:TPU:0 (pid 1)", [
+        ("XLA Ops", 0, [("fusion.1", 2 * MS, 2 * MS),
+                        ("fusion.2", 6 * MS, 2 * MS),
+                        ("fusion.1", 12 * MS, 2 * MS)]),
+    ])
+    return space
+
+
+def test_annotation_windows_scan_all_lines():
+    windows = _annotation_windows([_attribution_space()],
+                                  ["phase-a", "phase-b", "missing"])
+    assert set(windows) == {"phase-a", "phase-b"}
+    assert windows["phase-a"] == [(0, 10 * MS)]
+    assert windows["phase-b"] == [(10 * MS, 20 * MS)]
+
+
+def test_attribute_device_time_joins_trace(tmp_path):
+    with open(tmp_path / "t.xplane.pb", "wb") as f:
+        f.write(_attribution_space().SerializeToString())
+    out = attribute_device_time(str(tmp_path), ["phase-a", "phase-b"])
+    assert out is not None
+    assert out["device_busy_s"] == pytest.approx(0.006)
+    assert out["per_phase"]["phase-a"] == pytest.approx(0.004)
+    assert out["per_phase"]["phase-b"] == pytest.approx(0.002)
+
+
+def test_attribute_device_time_empty_trace(tmp_path):
+    assert attribute_device_time(str(tmp_path), ["phase-a"]) is None

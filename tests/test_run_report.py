@@ -1,0 +1,211 @@
+"""End-to-end and unit coverage for the observability subsystem: the run
+report emitted by `RepairModel.run()` under `DELPHI_METRICS_PATH`, the
+metrics registry's disabled no-op behavior, thread-local `phase_span`
+stacks, and the `DELPHI_LOG_LEVEL` stderr handler."""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import observability as obs
+from delphi_tpu.observability.registry import MetricsRegistry
+from delphi_tpu.utils import phase_span, setup_logger
+
+PIPELINE_PHASES = [
+    "input validation", "error detection", "attr stats",
+    "cell domain analysis", "repair model training", "repairing",
+]
+
+
+def _tiny_df(n: int = 60) -> pd.DataFrame:
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "c0": rng.choice(["a", "b", "c"], n),
+        "c1": rng.choice(["x", "y"], n),
+        "c2": rng.choice(["p", "q", "r"], n),
+    })
+    df.loc[df["c0"] == "a", "c1"] = "x"  # learnable signal for the c1 model
+    df.loc[5:9, "c1"] = None
+    return df
+
+
+def _walk(span):
+    yield span
+    for child in span["children"]:
+        yield from _walk(child)
+
+
+@pytest.fixture
+def tiny(session):
+    session.register("run_report_tiny", _tiny_df())
+    yield
+    # keep later tests metrics-free even if a run in here failed mid-flight
+    obs.stop_recording(obs.current_recorder())
+
+
+def test_run_report_end_to_end(tiny, tmp_path, monkeypatch):
+    report_path = tmp_path / "report.json"
+    monkeypatch.setenv("DELPHI_METRICS_PATH", str(report_path))
+    monkeypatch.setenv("DELPHI_METRICS_EVENTS", "1")
+
+    repaired = delphi.repair \
+        .setTableName("run_report_tiny").setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]).run()
+    assert len(repaired) == 5
+    assert obs.current_recorder() is None, "recorder must deactivate"
+
+    report = json.loads(report_path.read_text())
+
+    # schema basics
+    assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert report["kind"] == obs.REPORT_KIND
+    assert report["status"] == "ok"
+    assert isinstance(report["created_at"], str)
+    assert report["run"]["input_table"].endswith("run_report_tiny")
+    assert report["run"]["n_rows"] == 60
+    assert report["run"]["result_rows"] == 5
+    assert report["env"]["backend"] == "cpu"
+
+    # span tree: all six pipeline phases nest under the run root
+    root = report["spans"]
+    assert root["name"] == "repair.run"
+    children = [s["name"] for s in root["children"]]
+    assert children == PIPELINE_PHASES
+    for span in _walk(root):
+        assert span["wall_s"] >= 0.0
+        assert span["start_s"] >= 0.0
+    assert root["wall_s"] >= max(
+        s["start_s"] + s["wall_s"] for s in root["children"])
+
+    # metrics: at least 8 distinct pipeline metrics with sane types
+    metrics = report["metrics"]
+    names = list(metrics["counters"]) + list(metrics["gauges"]) \
+        + list(metrics["histograms"])
+    assert len(names) >= 8, names
+    assert metrics["counters"]["detect.cells_scanned"] == 180
+    assert metrics["counters"]["detect.null_cells"] == 5
+    assert metrics["gauges"]["pipeline.input_rows"] == 60
+    assert metrics["gauges"]["pipeline.error_cells"] == 5
+    assert metrics["gauges"]["system.peak_rss_gb"] > 0
+    hist = metrics["histograms"]["train.model_build_seconds"]
+    assert hist["count"] >= 1 and hist["sum"] >= 0.0
+
+    # JSONL event stream: one enter+exit pair per span
+    events = [json.loads(ln) for ln in
+              (tmp_path / "report.json.events.jsonl").read_text().splitlines()]
+    enters = [e["name"] for e in events if e["event"] == "span_enter"]
+    exits = [e["name"] for e in events if e["event"] == "span_exit"]
+    assert sorted(enters) == sorted(exits) == sorted(PIPELINE_PHASES)
+
+
+def test_run_report_written_on_failure(session, tmp_path, monkeypatch):
+    report_path = tmp_path / "failed.json"
+    monkeypatch.setenv("DELPHI_METRICS_PATH", str(report_path))
+    with pytest.raises(ValueError):
+        delphi.repair.setTableName("no_such_table").setRowId("tid").run()
+    report = json.loads(report_path.read_text())
+    assert report["status"] == "error"
+    assert "error" in report
+    assert obs.current_recorder() is None
+
+
+def test_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("DELPHI_METRICS_PATH", raising=False)
+    assert obs.metrics_path() is None
+    assert obs.current_recorder() is None
+    # helpers must silently drop writes when no recorder is active
+    obs.counter_inc("x", 3)
+    obs.gauge_set("y", 1.5)
+    obs.histogram_observe("z", 0.1)
+
+
+def test_registry_snapshot():
+    reg = MetricsRegistry()
+    reg.inc("a")
+    reg.inc("a", 4)
+    reg.set_gauge("g", 2.0)
+    reg.max_gauge("m", 1)
+    reg.max_gauge("m", 5)
+    reg.max_gauge("m", 3)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        reg.observe("h", v)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a": 5}
+    assert snap["gauges"] == {"g": 2.0, "m": 5}
+    hist = snap["histograms"]["h"]
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(10.0)
+    assert hist["min"] == 1.0 and hist["max"] == 4.0
+    assert hist["mean"] == pytest.approx(2.5)
+    assert hist["p50"] == 3.0
+
+
+def test_phase_span_stack_is_thread_local():
+    recorder = obs.start_recording("threaded")
+    assert recorder is not None
+    try:
+        ready = threading.Barrier(3, timeout=10)
+        done = threading.Event()
+
+        def worker(name):
+            with phase_span(name):
+                ready.wait()   # both workers + main hold spans concurrently
+                done.wait(10)
+
+        threads = [threading.Thread(target=worker, args=(f"worker-{i}",))
+                   for i in range(2)]
+        with phase_span("main-span"):
+            for t in threads:
+                t.start()
+            ready.wait()
+            done.set()
+        for t in threads:
+            t.join(10)
+    finally:
+        obs.stop_recording(recorder)
+
+    by_name = {s.name: s for s in recorder.root.walk()}
+    # worker spans attach to the ROOT (their stacks are their own), never to
+    # the main thread's concurrently-open span — the shared-list bug would
+    # interleave them and pop the wrong entries
+    root_children = {s.name for s in recorder.root.children}
+    assert {"worker-0", "worker-1", "main-span"} <= root_children
+    assert by_name["main-span"].children == []
+    assert by_name["worker-0"].thread is not None
+
+
+def test_nested_recording_keeps_outer():
+    outer = obs.start_recording("outer")
+    try:
+        assert obs.start_recording("inner") is None
+        assert obs.current_recorder() is outer
+    finally:
+        obs.stop_recording(outer)
+    assert obs.current_recorder() is None
+
+
+def test_setup_logger_honors_delphi_log_level(monkeypatch):
+    logger = logging.getLogger("delphi_tpu")
+
+    def stderr_handlers():
+        return [h for h in logger.handlers
+                if getattr(h, "_delphi_stderr", False)]
+
+    monkeypatch.setenv("DELPHI_LOG_LEVEL", "debug")
+    try:
+        setup_logger()
+        setup_logger()  # idempotent: still exactly one stderr handler
+        handlers = stderr_handlers()
+        assert len(handlers) == 1
+        assert logger.level == logging.DEBUG
+        assert "asctime" in handlers[0].formatter._fmt
+    finally:
+        for h in stderr_handlers():
+            logger.removeHandler(h)
+        logger.setLevel(logging.INFO)
