@@ -373,6 +373,13 @@ def _spawn_child(args: argparse.Namespace, backend: str, init_timeout: int,
     # per-phase heartbeats on the child's stderr: a killed run's tail then
     # names the phase it died in (persisted into backend_fallback below)
     env.setdefault("DELPHI_PHASE_HEARTBEAT", "1")
+    # arm the stall watchdog well inside the parent's kill deadline: a child
+    # wedged in compile or a dead TPU tunnel dumps its thread stacks to
+    # stderr (captured in the tail) before the parent gives up on it
+    env.setdefault("DELPHI_STALL_TIMEOUT_S",
+                   str(max(60, CHILD_RUN_TIMEOUT // 3)))
+    if args.metrics_port is not None:
+        env["DELPHI_METRICS_PORT"] = str(args.metrics_port)
     cmd = [sys.executable, os.path.abspath(__file__), "--_child",
            "--workload", args.workload, "--scale", str(args.scale)]
     if args.profile:
@@ -428,6 +435,11 @@ def main() -> None:
                         default="flights")
     parser.add_argument("--profile", action="store_true",
                         help="sample device utilization during the run")
+    parser.add_argument("--metrics-port", dest="metrics_port", type=int,
+                        default=None,
+                        help="serve live telemetry from the measured child "
+                             "(/metrics, /healthz, /report) on this port; "
+                             "long --scale runs become observable mid-flight")
     parser.add_argument("--backend", choices=["auto", "tpu", "cpu"],
                         default="auto")
     parser.add_argument("--_child", action="store_true",

@@ -53,6 +53,14 @@ def main(argv=None) -> int:
                              "see docs/source/observability.rst) to this "
                              "path; equivalent to DELPHI_METRICS_PATH but "
                              "also covers CSV ingestion")
+    parser.add_argument("--metrics-port", dest="metrics_port", type=int,
+                        default=None,
+                        help="serve live telemetry (/metrics Prometheus "
+                             "text, /healthz, /report) on this port for the "
+                             "duration of the run, plus a stall watchdog "
+                             "and resource sampler; 0 picks an ephemeral "
+                             "port (printed on stderr). Equivalent to "
+                             "DELPHI_METRICS_PORT")
     args = parser.parse_args(argv)
 
     # multi-host: join the cluster before any backend use (no-op when
@@ -62,14 +70,23 @@ def main(argv=None) -> int:
 
     session = get_session()
     recorder = None
-    if args.metrics_out:
+    if args.metrics_port is not None:
+        session.conf["repair.metrics.port"] = str(args.metrics_port)
+    if args.metrics_out or args.metrics_port is not None:
         # The recorder opens here, before ingestion, so ingest.* metrics land
-        # in the report; the nested run() sees an active recorder, records
-        # into the same tree, and leaves report writing to this entry point.
+        # in the report (and the live server covers the whole batch run);
+        # the nested run() sees an active recorder, records into the same
+        # tree, and leaves report writing to this entry point.
         from delphi_tpu import observability as obs
-        session.conf["repair.metrics.path"] = args.metrics_out
+        if args.metrics_out:
+            session.conf["repair.metrics.path"] = args.metrics_out
         recorder = obs.start_recording(
-            "batch.main", events_path=obs.events_path_for(args.metrics_out))
+            "batch.main",
+            events_path=obs.events_path_for(args.metrics_out or None))
+        if recorder is not None and recorder.live is not None \
+                and recorder.live.port is not None:
+            print(f"live telemetry: http://127.0.0.1:{recorder.live.port}"
+                  "/metrics", file=sys.stderr)
     if args.input.endswith(".csv"):
         if args.chunksize > 0:
             from delphi_tpu.ingest import read_csv_encoded
@@ -112,13 +129,14 @@ def main(argv=None) -> int:
         if recorder is not None:
             from delphi_tpu import observability as obs
             obs.stop_recording(recorder)
-            obs.write_run_report(
-                obs.build_run_report(
-                    recorder,
-                    run={"input": args.input, "output": args.output,
-                         "status": status},
-                    status=status, error=error),
-                args.metrics_out)
+            if args.metrics_out:
+                obs.write_run_report(
+                    obs.build_run_report(
+                        recorder,
+                        run={"input": args.input, "output": args.output,
+                             "status": status},
+                        status=status, error=error),
+                    args.metrics_out)
     result.to_csv(args.output, index=False)
     print(f"wrote {len(result)} rows to {args.output}", file=sys.stderr)
     return 0

@@ -2185,12 +2185,16 @@ class RepairModel:
         config) is set, a versioned run-report JSON — span tree, metrics
         registry snapshot, and (with ``DELPHI_PROFILE_DIR``) per-phase
         device-time attribution — is written there when the run finishes,
-        whether it succeeds or fails (see delphi_tpu/observability)."""
+        whether it succeeds or fails. ``DELPHI_METRICS_PORT`` (or
+        ``repair.metrics.port``) additionally serves live telemetry —
+        ``/metrics``, ``/healthz``, ``/report`` — plus a stall watchdog and
+        resource sampler for the run's duration, with or without a report
+        path (see delphi_tpu/observability)."""
         from delphi_tpu import observability as obs
 
         report_path = obs.metrics_path()
         recorder = None
-        if report_path:
+        if report_path or obs.live_configured():
             recorder = obs.start_recording(
                 "repair.run", events_path=obs.events_path_for(report_path))
 
@@ -2209,14 +2213,15 @@ class RepairModel:
         finally:
             if recorder is not None:
                 obs.stop_recording(recorder)
-                try:
-                    obs.write_run_report(
-                        obs.build_run_report(recorder, run=run_info,
-                                             status=status, error=error),
-                        report_path)
-                except Exception as e:
-                    # Reporting must never mask the run's own outcome.
-                    _logger.warning(f"failed to write run report: {e}")
+                if report_path:
+                    try:
+                        obs.write_run_report(
+                            obs.build_run_report(recorder, run=run_info,
+                                                 status=status, error=error),
+                            report_path)
+                    except Exception as e:
+                        # Reporting must never mask the run's own outcome.
+                        _logger.warning(f"failed to write run report: {e}")
 
     def _run_checked(self, run_info: Dict[str, Any],
                      detect_errors_only: bool,

@@ -1,6 +1,6 @@
 """Unified observability for the repair pipeline.
 
-Three pieces (see docs/source/observability.rst):
+Four pieces (see docs/source/observability.rst):
 
 * :mod:`~delphi_tpu.observability.registry` — process-wide metrics registry
   (counters / gauges / histograms). Instrumentation calls the module-level
@@ -10,26 +10,46 @@ Three pieces (see docs/source/observability.rst):
 * :mod:`~delphi_tpu.observability.report` — the versioned run-report JSON
   written at the end of ``RepairModel.run()`` when ``DELPHI_METRICS_PATH``
   or the ``repair.metrics.path`` session config is set, including per-phase
-  device-time attribution when a profiler trace was captured.
+  device-time attribution when a profiler trace was captured and a
+  ``per_process`` section on multi-host clusters.
+* :mod:`~delphi_tpu.observability.live` — the live telemetry plane: an HTTP
+  server (``/metrics`` Prometheus text, ``/healthz``, ``/report``) enabled
+  via ``DELPHI_METRICS_PORT`` / ``repair.metrics.port``, a stall watchdog,
+  and a periodic resource sampler.
 """
 
 import os
 from typing import Optional
 
+from delphi_tpu.observability.live import (  # noqa: F401
+    LivePlane, live_configured, metrics_port,
+)
 from delphi_tpu.observability.registry import (  # noqa: F401
     MetricsRegistry, counter_inc, gauge_max, gauge_set, histogram_observe,
 )
 from delphi_tpu.observability.report import (  # noqa: F401
-    REPORT_KIND, REPORT_SCHEMA_VERSION, attribute_device_time, bench_entry,
-    build_run_report, load_run_report, write_run_report,
+    REPORT_KIND, REPORT_SCHEMA_VERSION, SUPPORTED_SCHEMA_VERSIONS,
+    attribute_device_time, bench_entry, build_run_report, load_run_report,
+    upgrade_run_report, write_run_report,
 )
 from delphi_tpu.observability.spans import (  # noqa: F401
     RunRecorder, Span, current_recorder, start_recording, stop_recording,
 )
 
+# Values accepted as "on" by every boolean observability toggle
+# (DELPHI_METRICS_EVENTS, repair.metrics.events, DELPHI_PHASE_HEARTBEAT,
+# the live-server toggles, ...). One parser so env and session-conf spellings
+# can't drift apart again.
+_TRUTHY = frozenset({"1", "true", "yes", "on"})
+
+
+def _flag_enabled(value: Optional[str]) -> bool:
+    """True when ``value`` spells an enabled flag: 1/true/yes/on, any case."""
+    return value is not None and str(value).strip().lower() in _TRUTHY
+
 
 def metrics_path() -> Optional[str]:
-    """The configured run-report destination, or ``None`` when observability
+    """The configured run-report destination, or ``None`` when the run report
     is disabled (`DELPHI_METRICS_PATH` env wins over the
     ``repair.metrics.path`` session config)."""
     path = os.environ.get("DELPHI_METRICS_PATH")
@@ -40,13 +60,15 @@ def metrics_path() -> Optional[str]:
     return get_session().conf.get("repair.metrics.path") or None
 
 
-def events_path_for(path: str) -> Optional[str]:
+def events_path_for(path: Optional[str]) -> Optional[str]:
     """JSONL event-stream destination next to the report, enabled by
-    ``DELPHI_METRICS_EVENTS=1`` or ``repair.metrics.events=true``."""
-    if os.environ.get("DELPHI_METRICS_EVENTS") == "1":
+    ``DELPHI_METRICS_EVENTS`` or ``repair.metrics.events`` (1/true/yes)."""
+    if not path:
+        return None
+    if _flag_enabled(os.environ.get("DELPHI_METRICS_EVENTS")):
         return path + ".events.jsonl"
     from delphi_tpu.session import get_session
 
-    if get_session().conf.get("repair.metrics.events", "").lower() == "true":
+    if _flag_enabled(get_session().conf.get("repair.metrics.events")):
         return path + ".events.jsonl"
     return None

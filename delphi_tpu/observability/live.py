@@ -1,0 +1,455 @@
+"""Live telemetry plane: HTTP endpoints, stall watchdog, resource sampler.
+
+PR 1's run report is post-hoc — nothing is observable until the JSON lands.
+This module is the *live* half of the observability subsystem, attached to a
+:class:`~delphi_tpu.observability.spans.RunRecorder` for the duration of one
+run:
+
+* an HTTP server (stdlib ``ThreadingHTTPServer``, no dependencies) exposing
+
+  - ``/metrics``  — Prometheus text exposition rendered from the live
+    ``MetricsRegistry`` snapshot plus current-phase / span-depth gauges,
+  - ``/healthz``  — liveness JSON,
+  - ``/report``   — the in-flight run report (same schema as the final one,
+    with ``"status": "running"``);
+
+* a **watchdog** thread that heartbeats every thread's active span stack
+  into the JSONL event stream and, when no span transition has happened for
+  the stall timeout (hung XLA compile, wedged DCN collective), dumps all
+  Python thread stacks via ``sys._current_frames()`` to the log and bumps
+  the ``watchdog.stalls`` counter;
+
+* a **resource sampler** thread recording process RSS and per-device HBM
+  ``memory_stats()`` bytes-in-use gauges, plus a jit compile-time histogram
+  fed by a ``jax.monitoring`` duration listener.
+
+Configuration (env beats session conf; nothing here runs unless one of the
+first two is set):
+
+    DELPHI_METRICS_PORT / repair.metrics.port      serve HTTP on this port
+                                                   (0 = ephemeral; read the
+                                                   bound port from the log
+                                                   or ``LivePlane.port``)
+    DELPHI_STALL_TIMEOUT_S /                       watchdog stall threshold,
+        repair.metrics.stall_timeout_s             seconds (default 300;
+                                                   <= 0 disables stall
+                                                   detection)
+    DELPHI_RESOURCE_SAMPLE_S /                     sampler period, seconds
+        repair.metrics.sample_interval_s           (default 10; <= 0 off)
+    DELPHI_RESOURCE_SAMPLER                        boolean sampler toggle
+                                                   (default on)
+    DELPHI_METRICS_HOST                            bind address (default
+                                                   127.0.0.1)
+
+With none of them set, ``maybe_start`` is two config lookups and no thread,
+socket, or listener is ever created.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+DEFAULT_STALL_TIMEOUT_S = 300.0
+DEFAULT_SAMPLE_INTERVAL_S = 10.0
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# -- configuration -----------------------------------------------------------
+
+
+def _parse_number(raw: Any, what: str, cast) -> Optional[float]:
+    try:
+        return cast(str(raw).strip())
+    except (TypeError, ValueError):
+        _logger.warning(f"invalid {what}: {raw!r} (ignored)")
+        return None
+
+
+def _env_or_conf(env_key: str, conf_key: str, cast) -> Optional[float]:
+    raw = os.environ.get(env_key)
+    if raw is not None and str(raw).strip() != "":
+        return _parse_number(raw, env_key, cast)
+    from delphi_tpu.session import get_session
+
+    session = get_session()
+    return session.conf_int(conf_key) if cast is int \
+        else session.conf_float(conf_key)
+
+
+def metrics_port() -> Optional[int]:
+    """The configured live-server port (0 = ephemeral), or ``None`` when no
+    server is requested. ``DELPHI_METRICS_PORT`` wins over the
+    ``repair.metrics.port`` session config."""
+    port = _env_or_conf("DELPHI_METRICS_PORT", "repair.metrics.port", int)
+    return None if port is None else int(port)
+
+
+def stall_timeout_s() -> Optional[float]:
+    """The *explicitly configured* watchdog stall timeout, or ``None`` when
+    unset (the plane then uses :data:`DEFAULT_STALL_TIMEOUT_S` if it runs
+    for another reason)."""
+    return _env_or_conf("DELPHI_STALL_TIMEOUT_S",
+                        "repair.metrics.stall_timeout_s", float)
+
+
+def sample_interval_s() -> float:
+    interval = _env_or_conf("DELPHI_RESOURCE_SAMPLE_S",
+                            "repair.metrics.sample_interval_s", float)
+    return DEFAULT_SAMPLE_INTERVAL_S if interval is None else float(interval)
+
+
+def live_configured() -> bool:
+    """True when a run should activate the live plane: a metrics port is
+    configured, or a stall timeout was set explicitly (watchdog-only mode
+    for headless runs that just want hang diagnostics)."""
+    return metrics_port() is not None or stall_timeout_s() is not None
+
+
+def maybe_start(recorder: Any) -> Optional["LivePlane"]:
+    """Starts the live plane for ``recorder`` when configured; returns the
+    plane (also stored on ``recorder.live``) or ``None``. Cheap when
+    disabled: two config lookups, no threads."""
+    port = metrics_port()
+    stall = stall_timeout_s()
+    if port is None and stall is None:
+        return None
+    from delphi_tpu import observability as obs
+
+    sampler_on = obs._flag_enabled(
+        os.environ.get("DELPHI_RESOURCE_SAMPLER", "1"))
+    plane = LivePlane(
+        recorder, port=port,
+        stall_timeout=DEFAULT_STALL_TIMEOUT_S if stall is None else stall,
+        sample_interval=sample_interval_s() if sampler_on else 0.0)
+    plane.start()
+    recorder.live = plane
+    return plane
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    sanitized = _NAME_SUB.sub("_", name)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return "delphi_" + sanitized
+
+
+def _prom_value(value: Any) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def _prom_label(value: str) -> str:
+    return value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def render_prometheus(recorder: Any) -> str:
+    """The live registry plus run-level gauges in Prometheus text exposition
+    format 0.0.4. Counters and gauges map 1:1; histograms render as
+    summaries (p50/p95 quantiles over the reservoir sample)."""
+    snap = recorder.registry.snapshot()
+    lines: List[str] = []
+
+    def emit(name: str, kind: str, samples: List[str]) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+
+    for name, value in snap["counters"].items():
+        pn = _prom_name(name)
+        emit(pn, "counter", [f"{pn} {_prom_value(value)}"])
+    for name, value in snap["gauges"].items():
+        pn = _prom_name(name)
+        emit(pn, "gauge", [f"{pn} {_prom_value(value)}"])
+    for name, hist in snap["histograms"].items():
+        pn = _prom_name(name)
+        samples = []
+        for q, key in (("0.5", "p50"), ("0.95", "p95")):
+            if hist[key] is not None:
+                samples.append(
+                    f'{pn}{{quantile="{q}"}} {_prom_value(hist[key])}')
+        samples.append(f"{pn}_sum {_prom_value(hist['sum'])}")
+        samples.append(f"{pn}_count {_prom_value(hist['count'])}")
+        emit(pn, "summary", samples)
+
+    emit("delphi_run_elapsed_seconds", "gauge",
+         [f"delphi_run_elapsed_seconds {recorder.elapsed_s():.6f}"])
+    emit("delphi_span_depth", "gauge",
+         [f"delphi_span_depth {recorder.span_depth()}"])
+    emit("delphi_span_transitions_total", "counter",
+         [f"delphi_span_transitions_total {recorder.transition_count}"])
+    idle = time.perf_counter() - recorder.last_transition
+    emit("delphi_span_idle_seconds", "gauge",
+         [f"delphi_span_idle_seconds {idle:.6f}"])
+    emit("delphi_current_phase_info", "gauge",
+         ['delphi_current_phase_info{phase="%s"} 1'
+          % _prom_label(recorder.current_phase)])
+    return "\n".join(lines) + "\n"
+
+
+# -- HTTP endpoints ----------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the pipeline logger owns narration; default stderr access logs would
+    # interleave with it on every scrape
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _logger.debug("metrics server: " + fmt % args)
+
+    def _respond(self, status: int, content_type: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        plane: "LivePlane" = self.server.plane  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                body = json.dumps({
+                    "status": "ok",
+                    "phase": plane.recorder.current_phase,
+                    "elapsed_s": round(plane.recorder.elapsed_s(), 3),
+                }).encode()
+                self._respond(200, "application/json", body)
+            elif path == "/metrics":
+                body = render_prometheus(plane.recorder).encode()
+                self._respond(200, PROMETHEUS_CONTENT_TYPE, body)
+            elif path == "/report":
+                from delphi_tpu.observability.report import build_run_report
+
+                report = build_run_report(
+                    plane.recorder,
+                    run={"in_flight": True,
+                         "elapsed_s": round(plane.recorder.elapsed_s(), 3)},
+                    status="running")
+                body = json.dumps(report, indent=2).encode()
+                self._respond(200, "application/json", body)
+            else:
+                self._respond(404, "application/json",
+                              b'{"error": "not found"}')
+        except Exception as e:
+            # a scrape failure must not kill the handler thread loudly
+            _logger.warning(f"metrics endpoint {path} failed: {e}")
+            try:
+                self._respond(500, "application/json",
+                              json.dumps({"error": str(e)}).encode())
+            except Exception:
+                pass
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+def _dump_thread_stacks(recorder: Any, idle_s: float) -> None:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    active = recorder.active_spans()
+    lines = [f"watchdog: no span transition for {idle_s:.1f}s "
+             f"(active spans: {active or 'none'}); "
+             "dumping all thread stacks:"]
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} (ident {ident}) ---")
+        lines.append("".join(traceback.format_stack(frame)).rstrip())
+    text = "\n".join(lines)
+    _logger.warning(text)
+    # Also straight to stderr: a stall dump is last-resort evidence for a
+    # supervisor about to kill this process (bench.py captures the tail),
+    # and the library logger may have no handler attached.
+    print(text, file=sys.stderr, flush=True)
+
+
+class _Watchdog(threading.Thread):
+    """Heartbeats the active span stacks into the event stream and detects
+    stalls: a run whose recorder has seen no span transition for the timeout
+    is presumed wedged (hung compile, dead DCN peer) and gets its thread
+    stacks dumped — once per stall, not once per tick."""
+
+    def __init__(self, plane: "LivePlane", timeout_s: float) -> None:
+        super().__init__(name="delphi-watchdog", daemon=True)
+        self._plane = plane
+        self._timeout_s = timeout_s
+        self._tick_s = min(1.0, max(0.05, timeout_s / 4)) \
+            if timeout_s > 0 else 1.0
+        self._dumped_at_transition = -1
+
+    def run(self) -> None:
+        rec = self._plane.recorder
+        while not self._plane.stopped.wait(self._tick_s):
+            idle_s = time.perf_counter() - rec.last_transition
+            rec.emit_event({"event": "heartbeat",
+                            "t_s": round(rec.elapsed_s(), 3),
+                            "idle_s": round(idle_s, 3),
+                            "active": rec.active_spans()})
+            if self._timeout_s > 0 and idle_s >= self._timeout_s \
+                    and rec.transition_count != self._dumped_at_transition:
+                self._dumped_at_transition = rec.transition_count
+                rec.registry.inc("watchdog.stalls")
+                rec.emit_event({"event": "stall",
+                                "t_s": round(rec.elapsed_s(), 3),
+                                "idle_s": round(idle_s, 3),
+                                "active": rec.active_spans()})
+                _dump_thread_stacks(rec, idle_s)
+
+
+# -- resource sampler --------------------------------------------------------
+
+
+def _rss_gb() -> Optional[float]:
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return round(int(ln.split()[1]) / 1024 / 1024, 4)
+    except Exception:
+        pass
+    return None
+
+
+class _ResourceSampler(threading.Thread):
+    """Periodic process/device resource gauges: RSS, per-device HBM
+    bytes-in-use. Paired with the compile-time listener this answers 'what
+    was the run doing to the machine' without attaching a profiler."""
+
+    def __init__(self, plane: "LivePlane", interval_s: float) -> None:
+        super().__init__(name="delphi-resource-sampler", daemon=True)
+        self._plane = plane
+        self._interval_s = interval_s
+
+    def run(self) -> None:
+        while not self._plane.stopped.wait(self._interval_s):
+            try:
+                self._sample()
+            except Exception as e:
+                _logger.debug(f"resource sample failed: {e}")
+
+    def _sample(self) -> None:
+        reg = self._plane.recorder.registry
+        rss = _rss_gb()
+        if rss is not None:
+            reg.set_gauge("process.rss_gb", rss)
+            reg.max_gauge("process.peak_rss_gb", rss)
+        if "jax" not in sys.modules:
+            return
+        import jax
+
+        total_in_use = total_peak = 0
+        seen = False
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            seen = True
+            in_use = stats.get("bytes_in_use", 0)
+            total_in_use += in_use
+            total_peak += stats.get("peak_bytes_in_use", 0)
+            reg.set_gauge(f"device.{d.id}.bytes_in_use", in_use)
+        if seen:
+            reg.set_gauge("device.bytes_in_use", total_in_use)
+            reg.max_gauge("device.peak_bytes_in_use", total_peak)
+
+
+# jit compile-time histogram: one process-wide jax.monitoring listener that
+# forwards compilation durations to whatever recorder is active. Installed
+# once, on the first live-plane start (listeners can't be unregistered
+# portably, so the forwarding indirection keeps repeated runs from stacking).
+_compile_listener_lock = threading.Lock()
+_compile_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    global _compile_listener_installed
+    with _compile_listener_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw: Any) -> None:
+            if "compil" not in event:
+                return
+            from delphi_tpu.observability import spans
+
+            rec = spans._current
+            if rec is not None:
+                rec.registry.observe("jit.compile_seconds", duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        _logger.debug(f"jit compile-time listener unavailable: {e}")
+
+
+# -- the plane ---------------------------------------------------------------
+
+
+class LivePlane:
+    """Owns the live-telemetry threads for one recorder: HTTP server (when a
+    port is configured), watchdog, and resource sampler. ``stop()`` is
+    idempotent and joins everything so no thread outlives the run."""
+
+    def __init__(self, recorder: Any, port: Optional[int],
+                 stall_timeout: float = DEFAULT_STALL_TIMEOUT_S,
+                 sample_interval: float = DEFAULT_SAMPLE_INTERVAL_S) -> None:
+        self.recorder = recorder
+        self.stopped = threading.Event()
+        self._requested_port = port
+        self._stall_timeout = stall_timeout
+        self._sample_interval = sample_interval
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+        self.port: Optional[int] = None
+
+    def start(self) -> None:
+        if self._requested_port is not None:
+            host = os.environ.get("DELPHI_METRICS_HOST", "127.0.0.1")
+            self._server = ThreadingHTTPServer(
+                (host, self._requested_port), _Handler)
+            self._server.daemon_threads = True
+            self._server.plane = self  # type: ignore[attr-defined]
+            self.port = self._server.server_address[1]
+            server_thread = threading.Thread(
+                target=self._server.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="delphi-metrics-server", daemon=True)
+            server_thread.start()
+            self._threads.append(server_thread)
+            _logger.info(
+                f"live telemetry serving on http://{host}:{self.port} "
+                "(/metrics, /healthz, /report)")
+        watchdog = _Watchdog(self, self._stall_timeout)
+        watchdog.start()
+        self._threads.append(watchdog)
+        if self._sample_interval > 0:
+            sampler = _ResourceSampler(self, self._sample_interval)
+            sampler.start()
+            self._threads.append(sampler)
+        _install_compile_listener()
+
+    def stop(self) -> None:
+        self.stopped.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        for thread in self._threads:
+            thread.join(timeout=5)
+        self._threads = []

@@ -8,26 +8,31 @@ active (i.e. neither ``DELPHI_METRICS_PATH`` nor ``repair.metrics.path`` is
 set), so always-on instrumentation costs nothing on the default path.
 """
 
+import random
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Union
 
 Number = Union[int, float]
 
 # How many raw observations a histogram keeps for percentile estimation.
-# Beyond this the count/sum/min/max stay exact but p50/p95 are computed from
-# the first _HIST_SAMPLE_CAP values only.
+# Beyond this the count/sum/min/max stay exact and p50/p95 come from a
+# uniform reservoir sample of _HIST_SAMPLE_CAP observations.
 _HIST_SAMPLE_CAP = 512
 
 
 class _Histogram:
-    __slots__ = ("count", "total", "min", "max", "samples")
+    __slots__ = ("count", "total", "min", "max", "samples", "_rng")
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "") -> None:
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.samples: List[float] = []
+        # Deterministic per-name seed: the same run produces the same
+        # reservoir, so reports stay reproducible and diffable.
+        self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
 
     def observe(self, value: float) -> None:
         self.count += 1
@@ -36,24 +41,42 @@ class _Histogram:
         self.max = value if self.max is None else max(self.max, value)
         if len(self.samples) < _HIST_SAMPLE_CAP:
             self.samples.append(value)
+        else:
+            # Algorithm R: every observation (not just the first 512) ends up
+            # in the reservoir with probability cap/count, so percentiles
+            # cover the whole run instead of its start-up.
+            j = self._rng.randrange(self.count)
+            if j < _HIST_SAMPLE_CAP:
+                self.samples[j] = value
 
     def summary(self) -> Dict[str, Any]:
-        s = sorted(self.samples)
+        return _summarize(self.count, self.total, self.min, self.max,
+                          self.samples)
 
-        def pct(q: float) -> Optional[float]:
-            if not s:
-                return None
-            return s[min(len(s) - 1, int(q * len(s)))]
+    def state(self) -> Dict[str, Any]:
+        """Picklable raw state (samples included) for cross-process merges."""
+        return {"count": self.count, "sum": self.total, "min": self.min,
+                "max": self.max, "samples": list(self.samples)}
 
-        return {
-            "count": self.count,
-            "sum": self.total,
-            "min": self.min,
-            "max": self.max,
-            "mean": (self.total / self.count) if self.count else None,
-            "p50": pct(0.50),
-            "p95": pct(0.95),
-        }
+
+def _summarize(count: int, total: float, mn: Optional[float],
+               mx: Optional[float], samples: List[float]) -> Dict[str, Any]:
+    s = sorted(samples)
+
+    def pct(q: float) -> Optional[float]:
+        if not s:
+            return None
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    return {
+        "count": count,
+        "sum": total,
+        "min": mn,
+        "max": mx,
+        "mean": (total / count) if count else None,
+        "p50": pct(0.50),
+        "p95": pct(0.95),
+    }
 
 
 class MetricsRegistry:
@@ -83,7 +106,7 @@ class MetricsRegistry:
         with self._lock:
             hist = self._histograms.get(name)
             if hist is None:
-                hist = self._histograms[name] = _Histogram()
+                hist = self._histograms[name] = _Histogram(name)
             hist.observe(float(value))
 
     def snapshot(self) -> Dict[str, Any]:
@@ -94,6 +117,63 @@ class MetricsRegistry:
                 "histograms": {k: v.summary() for k, v
                                in sorted(self._histograms.items())},
             }
+
+    def export_state(self) -> Dict[str, Any]:
+        """Raw, picklable registry contents (histogram reservoirs included)
+        — what non-zero ranks ship to rank 0 for the multi-host report."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: v.state()
+                               for k, v in self._histograms.items()},
+            }
+
+
+def state_snapshot(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Summary-form snapshot (same shape as :meth:`MetricsRegistry.snapshot`)
+    from one exported raw state."""
+    return {
+        "counters": dict(sorted(state["counters"].items())),
+        "gauges": dict(sorted(state["gauges"].items())),
+        "histograms": {
+            k: _summarize(h["count"], h["sum"], h["min"], h["max"],
+                          h["samples"])
+            for k, h in sorted(state["histograms"].items())},
+    }
+
+
+def merge_state_snapshots(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Cross-process merge of exported registry states: counters sum (the
+    cluster-wide total), gauges keep the max across ranks (peaks), and
+    histograms combine exactly on count/sum/min/max with percentiles
+    estimated from the concatenated reservoirs."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    for state in states:
+        for k, v in state["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in state["gauges"].items():
+            gauges[k] = v if k not in gauges else max(gauges[k], v)
+        for k, h in state["histograms"].items():
+            agg = hists.setdefault(k, {"count": 0, "sum": 0.0, "min": None,
+                                       "max": None, "samples": []})
+            agg["count"] += h["count"]
+            agg["sum"] += h["sum"]
+            for bound, pick in (("min", min), ("max", max)):
+                if h[bound] is not None:
+                    agg[bound] = h[bound] if agg[bound] is None \
+                        else pick(agg[bound], h[bound])
+            agg["samples"].extend(h["samples"])
+    return {
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "histograms": {
+            k: _summarize(h["count"], h["sum"], h["min"], h["max"],
+                          h["samples"])
+            for k, h in sorted(hists.items())},
+    }
 
 
 # Cached reference to the spans module, resolved on first use. Importing

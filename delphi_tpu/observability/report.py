@@ -2,20 +2,32 @@
 ``RepairModel.run()`` (and by ``bench.py``) when ``DELPHI_METRICS_PATH`` /
 ``repair.metrics.path`` is set.
 
-Schema (version 1)::
+Schema (version 2; version 1 reports still load, see
+:func:`load_run_report`)::
 
     {
-      "schema_version": 1,
+      "schema_version": 2,
       "kind": "delphi_tpu.run_report",
       "created_at": "<ISO-8601 UTC>",
-      "status": "ok" | "error",
+      "status": "ok" | "error" | "running",  # "running" from /report only
       "error": "<message>",                  # only when status == "error"
       "run":   {...},                        # caller-supplied run facts
       "env":   {backend, devices, versions},
       "metrics": {"counters": {}, "gauges": {}, "histograms": {}},
       "spans": {name, start_s, wall_s, [device_s], children: [...]},
-      "device_time": {trace_dir, device_busy_s, per_phase: {}} | null
+      "device_time": {trace_dir, device_busy_s, per_phase: {}} | null,
+      "per_process": null | {                # multi-host runs only
+        "<rank>": {"process_index": 0,
+                   "metrics": {...},         # that rank's own registry
+                   "spans": {...}}           # process-tagged span tree
+      }
     }
+
+On a multi-host cluster every rank's registry state and span tree travel
+through ``parallel.distributed.allgather_pickled`` at ``stop_recording``;
+the report's top-level ``metrics`` then hold the cluster-wide merge
+(counters summed, gauges maxed, histogram reservoirs combined) while
+``per_process`` preserves each rank's own view.
 
 Device-time attribution joins the xplane parser in
 ``delphi_tpu/utils/profiling.py`` against the ``TraceAnnotation`` ranges that
@@ -34,7 +46,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 1
+REPORT_SCHEMA_VERSION = 2
+SUPPORTED_SCHEMA_VERSIONS = (1, 2)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -190,6 +203,61 @@ def _record_memory_gauges(registry: Any) -> None:
         pass
 
 
+def gather_per_process(recorder: Any) -> None:
+    """Multi-host report aggregation (collective — every rank calls this at
+    ``stop_recording``): all-gathers each rank's raw registry state and span
+    tree and stores the rank-ordered payload list on
+    ``recorder.per_process``. Single-process runs (and runs that never
+    touched jax) are a no-op."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    from delphi_tpu.parallel import distributed
+
+    if distributed.process_count() == 1:
+        return
+    payload = {
+        "process_index": distributed.process_index(),
+        "metrics": recorder.registry.export_state(),
+        "spans": recorder.root.to_dict(),
+    }
+    recorder.per_process = distributed.allgather_pickled(payload)
+
+
+def _tag_process(span_dict: Dict[str, Any], rank: int) -> None:
+    span_dict["process"] = rank
+    for child in span_dict.get("children", []):
+        _tag_process(child, rank)
+
+
+def _per_process_section(gathered: List[Dict[str, Any]]) \
+        -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(per_process section, merged cluster-wide metrics) from the gathered
+    rank payloads. Ranks are keyed by gather order — ``allgather_pickled``
+    returns payloads in process order on every rank."""
+    from delphi_tpu.observability.registry import (
+        merge_state_snapshots, state_snapshot)
+
+    import copy
+
+    section: Dict[str, Any] = {}
+    states = []
+    for rank, payload in enumerate(gathered):
+        # deep-copied before tagging: the tag mutates in place, and gathered
+        # payloads may alias (this rank's own payload, or test fakes that
+        # return the same object per rank)
+        spans = copy.deepcopy(payload["spans"])
+        _tag_process(spans, rank)
+        section[str(rank)] = {
+            "process_index": rank,
+            "metrics": state_snapshot(payload["metrics"]),
+            "spans": spans,
+        }
+        states.append(payload["metrics"])
+    return section, merge_state_snapshots(states)
+
+
 def build_run_report(recorder: Any,
                      run: Optional[Dict[str, Any]] = None,
                      status: str = "ok",
@@ -215,6 +283,13 @@ def build_run_report(recorder: Any,
                 if counts.get(s.name) == 1 and s.name in per_phase:
                     s.device_s = per_phase[s.name]
 
+    per_process = None
+    gathered = getattr(recorder, "per_process", None)
+    if gathered and len(gathered) > 1:
+        per_process, metrics = _per_process_section(gathered)
+    else:
+        metrics = recorder.registry.snapshot()
+
     return {
         "schema_version": REPORT_SCHEMA_VERSION,
         "kind": REPORT_KIND,
@@ -224,9 +299,10 @@ def build_run_report(recorder: Any,
         **({"error": error} if error else {}),
         "run": run or {},
         "env": _env_info(),
-        "metrics": recorder.registry.snapshot(),
+        "metrics": metrics,
         "spans": root.to_dict(),
         "device_time": device_time,
+        "per_process": per_process,
     }
 
 
@@ -249,13 +325,36 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
     _logger.info(f"Run report written to {path}")
 
 
+def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """In-memory v1 -> v2 upgrade: v2 only adds keys (``per_process``), so a
+    v1 report becomes a valid v2 one by defaulting them. Consumers can rely
+    on the v2 shape regardless of the file's age."""
+    version = report.get("schema_version")
+    if version == REPORT_SCHEMA_VERSION:
+        return report
+    report = dict(report)
+    report.setdefault("per_process", None)
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    report["schema_version_loaded_from"] = version
+    return report
+
+
 def load_run_report(path: str) -> Optional[Dict[str, Any]]:
+    """Loads and (when needed) upgrades a run report; ``None`` for missing
+    or unreadable files and for schema versions this build doesn't know."""
     try:
         with open(path) as f:
-            return json.load(f)
+            report = json.load(f)
     except Exception as e:
         _logger.warning(f"cannot load run report {path}: {e}")
         return None
+    version = report.get("schema_version")
+    if version not in SUPPORTED_SCHEMA_VERSIONS:
+        _logger.warning(
+            f"run report {path} has unsupported schema version {version} "
+            f"(supported: {SUPPORTED_SCHEMA_VERSIONS})")
+        return None
+    return upgrade_run_report(report)
 
 
 def bench_entry(metric: str, value: Any, unit: str,

@@ -75,8 +75,23 @@ class RunRecorder:
         # Filled in by profile_trace when a trace is captured during the run,
         # so the report builder knows where to find xplane files to join.
         self.trace_dir: Optional[str] = None
+        # Live telemetry plane (observability/live.py), attached by
+        # start_recording when DELPHI_METRICS_PORT & co. are configured.
+        self.live: Optional[Any] = None
+        # Gathered per-rank payloads (observability/report.py), filled at
+        # stop_recording on multi-host clusters.
+        self.per_process: Optional[List[Dict[str, Any]]] = None
+        # Span-transition clock for the stall watchdog: perf_counter of the
+        # last enter/exit plus a monotonically increasing transition count.
+        self.last_transition = self._t0
+        self.transition_count = 0
+        self.current_phase = name
         self._lock = threading.Lock()
         self._tls = threading.local()
+        # thread-ident -> (thread name, live stack list). The lists are only
+        # mutated by their owning threads; the map lets the watchdog and
+        # /metrics read every thread's active spans.
+        self._thread_stacks: Dict[int, Any] = {}
         self._events_fh: Optional[IO[str]] = None
         if events_path:
             try:
@@ -90,10 +105,29 @@ class RunRecorder:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
+            thread = threading.current_thread()
+            with self._lock:
+                self._thread_stacks[thread.ident or 0] = (thread.name, stack)
         return stack
 
     def elapsed_s(self) -> float:
         return time.perf_counter() - self._t0
+
+    def active_spans(self) -> Dict[str, List[str]]:
+        """Live snapshot of every thread's open span stack (root to leaf),
+        for the watchdog heartbeat and the /metrics span-depth gauges."""
+        with self._lock:
+            items = list(self._thread_stacks.values())
+        return {name: [s.name for s in stack]
+                for name, stack in items if stack}
+
+    def span_depth(self) -> int:
+        active = self.active_spans()
+        return max((len(v) for v in active.values()), default=0)
+
+    def _mark_transition(self) -> None:
+        self.last_transition = time.perf_counter()
+        self.transition_count += 1
 
     def span_enter(self, name: str) -> Span:
         now = time.perf_counter()
@@ -104,6 +138,8 @@ class RunRecorder:
         if thread is not threading.main_thread():
             span.thread = thread.name
         self._stack().append(span)
+        self.current_phase = name
+        self._mark_transition()
         self.emit_event({"event": "span_enter", "name": name,
                          "t_s": round(span.start_s, 6)})
         return span
@@ -120,6 +156,8 @@ class RunRecorder:
         parent = stack[-1] if stack else self.root
         with self._lock:
             parent.children.append(span)
+        self.current_phase = parent.name
+        self._mark_transition()
         self.emit_event({"event": "span_exit", "name": span.name,
                          "wall_s": round(span.wall_s, 6),
                          "failed": failed})
@@ -160,11 +198,20 @@ def start_recording(name: str,
                     events_path: Optional[str] = None) -> Optional[RunRecorder]:
     """Activates a run recorder, unless one is already active (a nested
     ``run()`` then records into the outer run's tree and returns ``None`` so
-    only the outer caller writes a report)."""
+    only the outer caller writes a report). When ``DELPHI_METRICS_PORT`` /
+    ``repair.metrics.port`` (or a stall timeout) is configured, the live
+    telemetry plane — HTTP server, watchdog, resource sampler — starts with
+    the recorder and stops with it."""
     global _current
     if _current is not None:
         return None
     _current = RunRecorder(name, events_path=events_path)
+    try:
+        from delphi_tpu.observability import live
+        live.maybe_start(_current)
+    except Exception as e:
+        # Telemetry must never take the run down with it.
+        _logger.warning(f"live telemetry plane failed to start: {e}")
     return _current
 
 
@@ -173,6 +220,20 @@ def stop_recording(recorder: Optional[RunRecorder]) -> None:
     if recorder is None:
         return
     recorder.finish()
+    if recorder.live is not None:
+        try:
+            recorder.live.stop()
+        except Exception as e:
+            _logger.warning(f"live telemetry plane failed to stop: {e}")
+        recorder.live = None
+    # Multi-host: every rank reaches this collective at the end of its run;
+    # the gathered per-rank payloads land on recorder.per_process for the
+    # report builder (single-process runs skip it entirely).
+    try:
+        from delphi_tpu.observability.report import gather_per_process
+        gather_per_process(recorder)
+    except Exception as e:
+        _logger.warning(f"multi-host report aggregation failed: {e}")
     recorder.close()
     if _current is recorder:
         _current = None

@@ -55,16 +55,32 @@ def maybe_initialize_distributed() -> bool:
     return True
 
 
+def process_count() -> int:
+    """Number of processes in the cluster. Every collective in this module
+    routes its single-process short-circuit through here (rather than
+    calling ``jax.process_count()`` inline) so tests can fake a multi-host
+    topology by monkeypatching one function."""
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This process's rank; the companion of :func:`process_count`."""
+    import jax
+
+    return jax.process_index()
+
+
 def allgather_host_bytes(payload: bytes) -> list:
     """All-gathers one opaque byte string per process (vocab unification for
     sharded ingestion). Two rounds over the device collective: lengths first,
     then the max-padded payloads — the multi-host analog of the driver
     collecting every executor's dictionary."""
-    import jax
     import numpy as np
     from jax.experimental import multihost_utils
 
-    if jax.process_count() == 1:
+    if process_count() == 1:
         return [payload]
     length = np.asarray([len(payload)], dtype=np.int32)
     lengths = np.asarray(
@@ -81,11 +97,10 @@ def allgather_host_bytes(payload: bytes) -> list:
 def allgather_sum(arr):
     """Elementwise sum of a small numeric array across processes (global
     counts from per-shard counts). Identity when single-process."""
-    import jax
     import numpy as np
 
     arr = np.asarray(arr)
-    if jax.process_count() == 1:
+    if process_count() == 1:
         return arr
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(arr)).sum(axis=0)
@@ -94,11 +109,10 @@ def allgather_sum(arr):
 def allgather_any(mask):
     """Elementwise logical OR of a small bool array across processes
     (global presence masks from per-shard masks)."""
-    import jax
     import numpy as np
 
     mask = np.asarray(mask, dtype=bool)
-    if jax.process_count() == 1:
+    if process_count() == 1:
         return mask
     from jax.experimental import multihost_utils
     return np.asarray(
@@ -107,11 +121,10 @@ def allgather_any(mask):
 
 def allgather_max(arr):
     """Elementwise max of a small numeric array across processes."""
-    import jax
     import numpy as np
 
     arr = np.asarray(arr)
-    if jax.process_count() == 1:
+    if process_count() == 1:
         return arr
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(arr)).max(axis=0)

@@ -31,6 +31,30 @@ class DelphiSession:
         self._catalog: Dict[str, pd.DataFrame] = {}
         self.conf: Dict[str, str] = {}
 
+    # -- typed config lookups ------------------------------------------------
+    # Session config values are strings (they arrive via setConf); the
+    # observability knobs (repair.metrics.port, stall timeouts, sample
+    # intervals) need numbers, and a typo must degrade to "knob off" with a
+    # warning rather than crash a run at telemetry setup.
+
+    def _conf_number(self, key: str, cast, default):
+        raw = self.conf.get(key)
+        if raw is None or str(raw).strip() == "":
+            return default
+        try:
+            return cast(str(raw).strip())
+        except (TypeError, ValueError):
+            _logger.warning(f"invalid value for {key}: {raw!r} "
+                            f"(expected {cast.__name__}); ignoring")
+            return default
+
+    def conf_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        return self._conf_number(key, int, default)
+
+    def conf_float(self, key: str,
+                   default: Optional[float] = None) -> Optional[float]:
+        return self._conf_number(key, float, default)
+
     @classmethod
     def get_or_create(cls) -> "DelphiSession":
         with cls._lock:

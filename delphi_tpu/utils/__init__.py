@@ -201,7 +201,12 @@ def _phase_heartbeat(marker: str, text: str) -> None:
     captured stderr tail — round 4's TPU timeouts recorded nothing but the
     backend-init warning, leaving 'tunnel down' and 'stuck in compile'
     indistinguishable."""
-    if os.environ.get("DELPHI_PHASE_HEARTBEAT") == "1":
+    raw = os.environ.get("DELPHI_PHASE_HEARTBEAT")
+    if raw is None:
+        return
+    from delphi_tpu.observability import _flag_enabled
+
+    if _flag_enabled(raw):
         import sys
         print(f"PHASE{marker} {time.strftime('%H:%M:%S')} {text}",
               file=sys.stderr, flush=True)

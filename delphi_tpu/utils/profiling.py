@@ -52,10 +52,16 @@ def _exec_lines(plane: Any) -> List[Any]:
     return op_lines if op_lines else lines
 
 
-def _busy_and_top_ops(planes: List[Any]) \
+# How many top kernels (by total device time) measurement reports. One
+# default shared by the parser and DeviceUtilization so the computed list and
+# the reported list can't silently disagree again.
+DEFAULT_TOP_KERNELS = 3
+
+
+def _busy_and_top_ops(planes: List[Any], top_k: int = DEFAULT_TOP_KERNELS) \
         -> Tuple[float, List[Tuple[str, float]]]:
     """(busy seconds — union of event intervals across device lines,
-    [(op name, total seconds)] top list)."""
+    [(op name, total seconds)] top-``top_k`` list)."""
     intervals: List[Tuple[int, int]] = []
     op_time: Dict[str, int] = {}
     for plane in planes:
@@ -81,7 +87,7 @@ def _busy_and_top_ops(planes: List[Any]) \
             cur_end = max(cur_end, e)
     if cur_end is not None:
         busy_ns += cur_end - cur_start
-    top = sorted(op_time.items(), key=lambda kv: -kv[1])[:8]
+    top = sorted(op_time.items(), key=lambda kv: -kv[1])[:top_k]
     return busy_ns / 1e9, [(n, t / 1e9) for n, t in top]
 
 
@@ -97,10 +103,16 @@ class DeviceUtilization:
     """
 
     def __init__(self, trace_dir: Optional[str] = None,
-                 keep_trace: bool = False) -> None:
+                 keep_trace: bool = False,
+                 top_kernels: int = DEFAULT_TOP_KERNELS) -> None:
         self._trace_dir = trace_dir or tempfile.mkdtemp(prefix="delphi_trace_")
         self._keep = keep_trace or trace_dir is not None
+        self._top_kernels = top_kernels
         self._started = False
+
+    def _cleanup(self) -> None:
+        if not self._keep:
+            shutil.rmtree(self._trace_dir, ignore_errors=True)
 
     def start(self) -> None:
         try:
@@ -108,28 +120,34 @@ class DeviceUtilization:
             self._started = True
         except Exception:
             self._started = False
+            # No trace will ever land here and callers that crash between
+            # start() and stop() never reach stop()'s cleanup — drop the
+            # (empty) dir now instead of leaking one per failed run.
+            self._cleanup()
 
     def stop(self, wall_seconds: float) -> Dict[str, Any]:
-        if not self._started:
-            if not self._keep:
-                shutil.rmtree(self._trace_dir, ignore_errors=True)
-            return {"device_busy_frac": None,
-                    "profile_error": "trace did not start"}
+        # The whole body runs under one try/finally: any exit — the normal
+        # return, a caught parse error, even a BaseException out of
+        # stop_trace() — releases the trace dir unless the caller asked to
+        # keep it.
         try:
+            if not self._started:
+                return {"device_busy_frac": None,
+                        "profile_error": "trace did not start"}
             jax.profiler.stop_trace()
             spaces = _load_xspaces(self._trace_dir)
             planes = _device_planes(spaces)
             if not planes:
                 return {"device_busy_frac": None,
                         "profile_error": "no device planes in trace"}
-            busy_s, top = _busy_and_top_ops(planes)
+            busy_s, top = _busy_and_top_ops(planes, self._top_kernels)
             frac = min(1.0, busy_s / wall_seconds) if wall_seconds > 0 else 0.0
             out: Dict[str, Any] = {
                 "device_busy_frac": round(frac, 4),
                 "device_busy_s": round(busy_s, 3),
                 "top_kernels": [
                     {"name": n[:120], "total_s": round(t, 4)}
-                    for n, t in top[:3]],
+                    for n, t in top],
             }
             if self._keep:
                 out["trace_dir"] = self._trace_dir
@@ -138,5 +156,4 @@ class DeviceUtilization:
             return {"device_busy_frac": None,
                     "profile_error": f"{type(e).__name__}: {e}"}
         finally:
-            if not self._keep:
-                shutil.rmtree(self._trace_dir, ignore_errors=True)
+            self._cleanup()

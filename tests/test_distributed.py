@@ -456,3 +456,105 @@ def test_process_local_single_process_matches_normal(session):
     sharded = run(dataclasses.replace(normal_table, process_local=True))
     pd.testing.assert_frame_equal(sharded, normal)
     assert len(normal) > 0
+
+
+# -- collective helpers, single-process identity paths -----------------------
+# (the in-process tests below never spawn a cluster: identity semantics when
+# process_count() == 1, and faked 2-rank topologies via monkeypatching the
+# two seams distributed.py routes every collective through)
+
+
+def test_allgather_identity_single_process():
+    import numpy as np
+
+    from delphi_tpu.parallel import distributed as dist
+
+    arr = np.asarray([1, 2, 3], dtype=np.int64)
+    out = dist.allgather_sum(arr)
+    assert out.tolist() == [1, 2, 3]
+
+    mask = dist.allgather_any(np.asarray([True, False]))
+    assert mask.dtype == bool and mask.tolist() == [True, False]
+
+    mx = dist.allgather_max(np.asarray([4.0, 5.0]))
+    assert mx.tolist() == [4.0, 5.0]
+
+    assert dist.allgather_host_bytes(b"payload") == [b"payload"]
+    obj = {"rank": 0, "values": [1, 2]}
+    assert dist.allgather_pickled(obj) == [obj]
+
+
+def test_allgather_faked_two_process(monkeypatch):
+    """2-rank semantics without a cluster: process_count() is the only seam
+    the short-circuits consult, and process_allgather is the only transport —
+    stacking the same array twice simulates two identical ranks."""
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+    from delphi_tpu.parallel import distributed as dist
+
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda arr: np.stack([np.asarray(arr)] * 2))
+
+    assert dist.allgather_sum(np.asarray([1, 2])).tolist() == [2, 4]
+    assert dist.allgather_any(np.asarray([True, False])).tolist() \
+        == [True, False]
+    assert dist.allgather_max(np.asarray([3.0, 7.0])).tolist() == [3.0, 7.0]
+    assert dist.allgather_host_bytes(b"xy") == [b"xy", b"xy"]
+    assert dist.allgather_pickled({"a": 1}) == [{"a": 1}, {"a": 1}]
+
+
+def test_report_merges_faked_two_process_run(monkeypatch):
+    """Acceptance criterion for multi-host aggregation: a run on a faked
+    2-process cluster produces a schema-v2 report whose per_process section
+    has one entry per rank and whose top-level counters equal the per-rank
+    sums."""
+    from delphi_tpu import observability as obs
+    from delphi_tpu.parallel import distributed as dist
+
+    recorder = obs.start_recording("dist-merge")
+    assert recorder is not None
+    try:
+        recorder.registry.inc("detect.cells_scanned", 90)
+        recorder.registry.set_gauge("pipeline.input_rows", 60)
+        recorder.registry.observe("train.model_build_seconds", 0.5)
+        recorder.registry.observe("train.model_build_seconds", 1.5)
+
+        monkeypatch.setattr(dist, "process_count", lambda: 2)
+        monkeypatch.setattr(dist, "process_index", lambda: 0)
+        monkeypatch.setattr(dist, "allgather_pickled",
+                            lambda obj: [obj, obj])
+    finally:
+        obs.stop_recording(recorder)
+
+    assert recorder.per_process is not None and len(recorder.per_process) == 2
+
+    report = obs.build_run_report(recorder, run={}, status="ok")
+    assert report["schema_version"] == 2
+    per_process = report["per_process"]
+    assert sorted(per_process) == ["0", "1"]
+    for rank, entry in per_process.items():
+        assert entry["metrics"]["counters"]["detect.cells_scanned"] == 90
+        assert entry["spans"]["process"] == int(rank)
+
+    merged = report["metrics"]
+    assert merged["counters"]["detect.cells_scanned"] == 180  # 90 + 90
+    assert merged["gauges"]["pipeline.input_rows"] == 60      # max, not sum
+    hist = merged["histograms"]["train.model_build_seconds"]
+    assert hist["count"] == 4 and hist["sum"] == pytest.approx(4.0)
+    assert hist["min"] == 0.5 and hist["max"] == 1.5
+
+
+def test_gather_per_process_noop_single_process():
+    from delphi_tpu import observability as obs
+
+    recorder = obs.start_recording("dist-single")
+    assert recorder is not None
+    recorder.registry.inc("c", 3)
+    obs.stop_recording(recorder)
+    assert recorder.per_process is None
+
+    report = obs.build_run_report(recorder, run={}, status="ok")
+    assert report["per_process"] is None
+    assert report["metrics"]["counters"]["c"] == 3

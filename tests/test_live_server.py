@@ -1,0 +1,286 @@
+"""Live telemetry plane tests (`delphi_tpu/observability/live.py`): the
+/metrics HTTP server on an ephemeral port, the stall watchdog, Prometheus
+rendering, config precedence, and — most load-bearing — the guarantee that
+the disabled path starts no threads at all."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import observability as obs
+from delphi_tpu.observability import live, spans
+
+_LIVE_ENV = ("DELPHI_METRICS_PORT", "DELPHI_STALL_TIMEOUT_S",
+             "DELPHI_RESOURCE_SAMPLE_S", "DELPHI_RESOURCE_SAMPLER",
+             "DELPHI_METRICS_PATH", "DELPHI_METRICS_EVENTS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_live_env(monkeypatch):
+    """Each test starts from an unconfigured plane and leaves no recorder
+    (and therefore no live threads) behind."""
+    for key in _LIVE_ENV:
+        monkeypatch.delenv(key, raising=False)
+    yield
+    obs.stop_recording(obs.current_recorder())
+
+
+def _get(port, path, timeout=5):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def test_live_server_smoke_on_ephemeral_port(monkeypatch):
+    # port 0: the OS picks; the test reads the bound port back from the
+    # plane rather than hardcoding one (tier-1 runs in shared containers)
+    monkeypatch.setenv("DELPHI_METRICS_PORT", "0")
+    # keep the sampler quiet so the test only exercises the server
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLER", "0")
+    recorder = obs.start_recording("live-smoke")
+    assert recorder is not None and recorder.live is not None
+    port = recorder.live.port
+    assert isinstance(port, int) and port > 0
+
+    recorder.registry.inc("repair.cells", 7)
+    recorder.registry.observe("train.seconds", 0.25)
+    span = spans.span_enter("phase one")
+    try:
+        status, ctype, body = _get(port, "/healthz")
+        assert status == 200 and ctype == "application/json"
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["phase"] == "phase one"
+        assert health["elapsed_s"] >= 0.0
+
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200
+        assert ctype == live.PROMETHEUS_CONTENT_TYPE
+        lines = body.splitlines()
+        assert "delphi_repair_cells 7" in lines
+        assert "# TYPE delphi_repair_cells counter" in lines
+        assert "# TYPE delphi_train_seconds summary" in lines
+        assert "delphi_train_seconds_count 1" in lines
+        assert 'delphi_current_phase_info{phase="phase one"} 1' in lines
+        assert "delphi_span_depth 1" in lines
+        # exposition format: every non-comment line is "name[{labels}] value"
+        for ln in lines:
+            if ln and not ln.startswith("#"):
+                name, value = ln.rsplit(" ", 1)
+                assert name.startswith("delphi_")
+                float(value)
+
+        status, _, body = _get(port, "/report")
+        report = json.loads(body)
+        assert status == 200
+        assert report["status"] == "running"
+        assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
+        assert report["run"]["in_flight"] is True
+        assert report["metrics"]["counters"]["repair.cells"] == 7
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(port, "/no-such-endpoint")
+        assert exc.value.code == 404
+    finally:
+        spans.span_exit(span)
+    obs.stop_recording(recorder)
+
+    # stop tears the socket down and joins every plane thread
+    with pytest.raises(urllib.error.URLError):
+        _get(port, "/healthz", timeout=2)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("delphi-")]
+
+
+def test_disabled_path_starts_no_threads(session):
+    """The acceptance bar for 'free when off': with no live config, a full
+    RepairModel.run() must leave threading.active_count() unchanged."""
+    rng = np.random.RandomState(3)
+    n = 50
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "c0": rng.choice(["a", "b"], n),
+        "c1": rng.choice(["x", "y"], n),
+    })
+    df.loc[df["c0"] == "a", "c1"] = "x"
+    df.loc[:4, "c1"] = None
+    session.register("live_disabled_tiny", df)
+
+    def run():
+        return delphi.repair \
+            .setTableName("live_disabled_tiny").setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]).run()
+
+    run()  # warm-up: jax/XLA lazily spawn their own pools on first use
+    before = threading.active_count()
+    result = run()
+    assert len(result) == 5
+    # tolerate a short-lived runtime thread winding down, but the plane's
+    # named threads must never exist and the count must settle back
+    deadline = time.time() + 5
+    while threading.active_count() != before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() == before
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("delphi-")]
+    assert obs.current_recorder() is None
+
+
+def test_watchdog_detects_stall_and_dumps_stacks(monkeypatch, caplog):
+    # watchdog-only mode: a stall timeout with no port still activates the
+    # plane (headless hang diagnostics), with no HTTP socket
+    monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLER", "0")
+    recorder = obs.start_recording("stall-test")
+    assert recorder is not None and recorder.live is not None
+    assert recorder.live.port is None
+
+    span = spans.span_enter("stuck phase")
+    try:
+        with caplog.at_level("WARNING", logger="delphi_tpu"):
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                stalls = recorder.registry.snapshot()["counters"] \
+                    .get("watchdog.stalls", 0)
+                if stalls >= 1:
+                    break
+                time.sleep(0.05)
+            assert stalls == 1
+            # one dump per stall, not one per tick: stay idle another few
+            # ticks and the counter must not move
+            time.sleep(0.5)
+            assert recorder.registry.snapshot()["counters"][
+                "watchdog.stalls"] == 1
+        dump = "\n".join(r.message for r in caplog.records
+                         if "dumping all thread stacks" in r.message)
+        assert "stuck phase" in dump          # names the wedged span
+        assert "--- thread MainThread" in dump
+        assert "delphi-watchdog" in dump
+    finally:
+        spans.span_exit(span)
+    obs.stop_recording(recorder)
+
+
+def test_watchdog_rearms_after_transition(monkeypatch):
+    monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLER", "0")
+    recorder = obs.start_recording("stall-rearm")
+
+    def stalls():
+        return recorder.registry.snapshot()["counters"] \
+            .get("watchdog.stalls", 0)
+
+    def wait_for(n):
+        deadline = time.time() + 10
+        while stalls() < n and time.time() < deadline:
+            time.sleep(0.05)
+        assert stalls() == n
+
+    span = spans.span_enter("first stall")
+    wait_for(1)
+    spans.span_exit(span)  # transition: re-arms the once-per-stall latch
+    span = spans.span_enter("second stall")
+    wait_for(2)
+    spans.span_exit(span)
+    obs.stop_recording(recorder)
+
+
+def test_watchdog_heartbeats_into_event_stream(tmp_path, monkeypatch):
+    monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLER", "0")
+    events = tmp_path / "events.jsonl"
+    recorder = obs.start_recording("hb", events_path=str(events))
+    span = spans.span_enter("slow phase")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if recorder.registry.snapshot()["counters"] \
+                .get("watchdog.stalls", 0) >= 1:
+            break
+        time.sleep(0.05)
+    spans.span_exit(span)
+    obs.stop_recording(recorder)
+
+    parsed = [json.loads(ln) for ln in events.read_text().splitlines()]
+    beats = [e for e in parsed if e["event"] == "heartbeat"]
+    stall_events = [e for e in parsed if e["event"] == "stall"]
+    assert beats, "watchdog must heartbeat the span stack into the stream"
+    assert any("slow phase" in stack
+               for e in beats for stack in e["active"].values())
+    assert stall_events and stall_events[0]["idle_s"] >= 0.2
+
+
+def test_resource_sampler_records_gauges(monkeypatch):
+    monkeypatch.setenv("DELPHI_METRICS_PORT", "0")
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLE_S", "0.05")
+    recorder = obs.start_recording("sampler")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        gauges = recorder.registry.snapshot()["gauges"]
+        if "process.rss_gb" in gauges:
+            break
+        time.sleep(0.05)
+    obs.stop_recording(recorder)
+    assert gauges["process.rss_gb"] > 0
+    assert gauges["process.peak_rss_gb"] >= gauges["process.rss_gb"]
+    # HBM gauges appear only on backends whose devices report memory_stats()
+    # (TPU/GPU); the CPU test backend returns none, so just assert the
+    # sampler agrees with the device rather than requiring the gauge
+    import jax
+    if any(d.memory_stats() for d in jax.local_devices()):
+        assert gauges["device.bytes_in_use"] > 0
+    else:
+        assert "device.bytes_in_use" not in gauges
+
+
+def test_live_config_env_beats_session_conf(session, monkeypatch):
+    assert live.metrics_port() is None
+    assert live.stall_timeout_s() is None
+    assert not live.live_configured()
+
+    session.conf["repair.metrics.port"] = "9105"
+    session.conf["repair.metrics.stall_timeout_s"] = "45"
+    try:
+        assert live.metrics_port() == 9105
+        assert live.stall_timeout_s() == 45.0
+        assert live.live_configured()
+        monkeypatch.setenv("DELPHI_METRICS_PORT", "0")
+        monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "7.5")
+        assert live.metrics_port() == 0      # 0 is a real value, not "unset"
+        assert live.stall_timeout_s() == 7.5
+    finally:
+        del session.conf["repair.metrics.port"]
+        del session.conf["repair.metrics.stall_timeout_s"]
+
+    # malformed values warn and read as unset instead of raising mid-run
+    monkeypatch.setenv("DELPHI_METRICS_PORT", "not-a-port")
+    monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "soon")
+    assert live.metrics_port() is None
+    assert live.stall_timeout_s() is None
+
+
+def test_prometheus_name_and_label_sanitization():
+    reg_names = {
+        "detect.cells_scanned": "delphi_detect_cells_scanned",
+        "device.0.bytes_in_use": "delphi_device_0_bytes_in_use",
+        "7weird name!": "delphi__7weird_name_",
+    }
+    for raw, want in reg_names.items():
+        assert live._prom_name(raw) == want
+    assert live._prom_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert live._prom_value(True) == "1"
+    assert live._prom_value(3) == "3"
+    assert float(live._prom_value(0.25)) == 0.25
+
+
+def test_flag_enabled_accepts_common_truthy_spellings():
+    for raw in ("1", "true", "TRUE", " Yes ", "on"):
+        assert obs._flag_enabled(raw), raw
+    for raw in (None, "", "0", "false", "no", "off", "2", "enabled"):
+        assert not obs._flag_enabled(raw), raw

@@ -147,3 +147,107 @@ def test_attribute_device_time_joins_trace(tmp_path):
 
 def test_attribute_device_time_empty_trace(tmp_path):
     assert attribute_device_time(str(tmp_path), ["phase-a"]) is None
+
+
+def test_busy_and_top_ops_honors_top_k():
+    space = xplane_pb2.XSpace()
+    plane = _add_plane(space, "/device:TPU:0", [
+        ("XLA Ops", 0, [(f"op.{i}", i * 10 * MS, (5 - i) * MS)
+                        for i in range(5)]),
+    ])
+    _, top_default = _busy_and_top_ops([plane])
+    from delphi_tpu.utils.profiling import DEFAULT_TOP_KERNELS
+    assert len(top_default) == DEFAULT_TOP_KERNELS
+    _, top_one = _busy_and_top_ops([plane], top_k=1)
+    assert top_one == [("op.0", pytest.approx(0.005))]
+    _, top_all = _busy_and_top_ops([plane], top_k=100)
+    assert [n for n, _ in top_all] == [f"op.{i}" for i in range(5)]
+
+
+def test_device_utilization_reports_configured_top_kernels(tmp_path,
+                                                           monkeypatch):
+    from delphi_tpu.utils import profiling
+
+    space = xplane_pb2.XSpace()
+    _add_plane(space, "/device:TPU:0", [
+        ("XLA Ops", 0, [(f"op.{i}", i * 10 * MS, (9 - i) * MS)
+                        for i in range(9)]),
+    ])
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+
+    def fake_start(path):
+        assert path == str(trace_dir)
+
+    def fake_stop():
+        with open(trace_dir / "t.xplane.pb", "wb") as f:
+            f.write(space.SerializeToString())
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", fake_stop)
+
+    util = profiling.DeviceUtilization(trace_dir=str(trace_dir),
+                                       top_kernels=7)
+    util.start()
+    out = util.stop(wall_seconds=1.0)
+    # the constructor arg flows through to the parser: 7 kernels, not the
+    # previous hard-coded [:3] re-truncation
+    assert [k["name"] for k in out["top_kernels"]] \
+        == [f"op.{i}" for i in range(7)]
+    assert out["trace_dir"] == str(trace_dir)
+
+
+def test_device_utilization_cleans_dir_when_start_fails(monkeypatch):
+    import os
+
+    from delphi_tpu.utils import profiling
+
+    def boom(path):
+        raise RuntimeError("profiler busy")
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace", boom)
+    util = profiling.DeviceUtilization()
+    trace_dir = util._trace_dir
+    assert os.path.isdir(trace_dir)
+    util.start()
+    assert not os.path.isdir(trace_dir), \
+        "failed start must not leak its temp trace dir"
+    assert util.stop(1.0)["profile_error"] == "trace did not start"
+
+
+def test_device_utilization_cleans_dir_when_stop_raises(monkeypatch):
+    import os
+
+    from delphi_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda path: None)
+
+    def interrupted():
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", interrupted)
+    util = profiling.DeviceUtilization()
+    trace_dir = util._trace_dir
+    util.start()
+    # BaseException escapes stop() (only Exception is swallowed), yet the
+    # finally still releases the trace dir
+    with pytest.raises(KeyboardInterrupt):
+        util.stop(1.0)
+    assert not os.path.isdir(trace_dir)
+
+
+def test_device_utilization_keeps_explicit_dir_on_error(tmp_path,
+                                                        monkeypatch):
+    from delphi_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda path: None)
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", lambda: None)
+    keep_dir = tmp_path / "keep"
+    keep_dir.mkdir()
+    util = profiling.DeviceUtilization(trace_dir=str(keep_dir))
+    util.start()
+    out = util.stop(1.0)  # empty trace -> parse error path
+    assert out["device_busy_frac"] is None
+    assert keep_dir.is_dir(), "caller-supplied dirs are never deleted"

@@ -209,3 +209,60 @@ def test_setup_logger_honors_delphi_log_level(monkeypatch):
         for h in stderr_handlers():
             logger.removeHandler(h)
         logger.setLevel(logging.INFO)
+
+
+def test_histogram_reservoir_is_unbiased():
+    """Regression for the first-512 sampling bias: after the cap, Algorithm R
+    keeps a uniform sample, so quantiles of a ramp 0..N track the full range
+    instead of freezing at the start-up values."""
+    reg = MetricsRegistry()
+    n = 4000
+    for v in range(n):
+        reg.observe("ramp", float(v))
+    hist = reg.snapshot()["histograms"]["ramp"]
+    assert hist["count"] == n
+    assert hist["min"] == 0.0 and hist["max"] == float(n - 1)
+    # the old code's p50 was ~256 and p95 ~486 forever; a uniform reservoir
+    # of 512 lands within a few hundred of the true quantiles
+    assert abs(hist["p50"] - n / 2) < n * 0.15
+    assert hist["p95"] > n * 0.75
+    # deterministic: same name -> same seed -> identical replacements
+    reg2 = MetricsRegistry()
+    for v in range(n):
+        reg2.observe("ramp", float(v))
+    assert reg2.snapshot()["histograms"]["ramp"] == hist
+
+
+def test_v1_report_upgrades_on_load(tmp_path):
+    v1 = {"schema_version": 1, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"}}
+    path = tmp_path / "v1.json"
+    path.write_text(json.dumps(v1))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == 2
+    assert loaded["schema_version_loaded_from"] == 1
+    assert loaded["per_process"] is None
+    assert loaded["metrics"] == {"counters": {}}  # payload untouched
+
+    unknown = {"schema_version": 99, "kind": obs.REPORT_KIND}
+    path2 = tmp_path / "v99.json"
+    path2.write_text(json.dumps(unknown))
+    assert obs.load_run_report(str(path2)) is None
+
+
+def test_session_typed_conf_lookup(session):
+    assert session.conf_int("repair.metrics.port") is None
+    assert session.conf_float("repair.metrics.stall_timeout_s", 1.5) == 1.5
+    session.conf["repair.metrics.port"] = "9100"
+    session.conf["repair.metrics.stall_timeout_s"] = "2.5"
+    session.conf["repair.metrics.bad"] = "nope"
+    try:
+        assert session.conf_int("repair.metrics.port") == 9100
+        assert session.conf_float("repair.metrics.stall_timeout_s") == 2.5
+        # malformed values warn and fall back instead of raising
+        assert session.conf_int("repair.metrics.bad", 7) == 7
+    finally:
+        for key in ("repair.metrics.port", "repair.metrics.stall_timeout_s",
+                    "repair.metrics.bad"):
+            del session.conf[key]
