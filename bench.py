@@ -252,6 +252,82 @@ def flights(scale: int, profile: bool = False) -> None:
     print(json.dumps(result), flush=True)
 
 
+def smoke() -> int:
+    """Tier-1-adjacent compile-plane check: runs a tiny deterministic repair
+    TWICE in this process on the CPU backend against one fresh persistent
+    compile-cache dir (`jax.clear_caches()` between runs, persistence
+    thresholds at zero so even sub-second CPU compiles are cached), and
+    asserts the warm second run records `compile_cache.hits > 0` in its run
+    report. Prints one JSON line; exit code 1 on assertion failure."""
+    import tempfile
+    cache_dir = tempfile.mkdtemp(prefix="delphi_smoke_cache_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = cache_dir
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    _force_cpu_backend()
+
+    import pandas as pd
+
+    import jax
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.observability import live
+    from delphi_tpu.session import get_session
+
+    # jit.compile_seconds normally rides the live plane; the smoke wants it
+    # in the per-run snapshots without starting any server
+    live._install_compile_listener()
+
+    n = 64
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    })
+    df.loc[df.index % 11 == 0, "c1"] = None
+
+    def one_run(tag: str) -> dict:
+        _heartbeat(f"smoke {tag} run")
+        name = f"smoke_{tag}"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.smoke.{tag}")
+        try:
+            delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+        snap = rec.registry.snapshot()
+        hist = snap["histograms"].get("jit.compile_seconds") or {}
+        return {
+            "hits": int(snap["counters"].get("compile_cache.hits", 0)),
+            "misses": int(snap["counters"].get("compile_cache.misses", 0)),
+            "compile_seconds": round(hist.get("sum") or 0.0, 3),
+        }
+
+    cold = one_run("cold")
+    # drop the in-memory executable caches so the second run must go back
+    # to the persistent directory for every compile
+    jax.clear_caches()
+    warm = one_run("warm")
+
+    ok = warm["hits"] > 0
+    print(json.dumps({
+        "metric": "compile_cache_smoke", "value": warm["hits"],
+        "unit": "cache hits", "vs_baseline": None, "ok": ok,
+        "cache_dir": cache_dir, "cold": cold, "warm": warm,
+    }), flush=True)
+    if not ok:
+        print("smoke FAILED: warm run recorded no compile-cache hits",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
 # On-chip measurements persist here keyed by workload@scale: the axon tunnel
@@ -370,6 +446,17 @@ def _spawn_child(args: argparse.Namespace, backend: str, init_timeout: int,
 
     env = dict(os.environ)
     env["DELPHI_BENCH_BACKEND"] = backend
+    if args.cache_mode == "cold":
+        # fresh empty compile cache: the child pays (and measures) full XLA
+        # compilation for every shape variant
+        import tempfile
+        env["DELPHI_COMPILE_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="delphi_bench_coldcache_")
+    elif args.cache_mode == "warm":
+        # stable dir shared by every --warm bench invocation: back-to-back
+        # runs of the same workload skip compilation on the second run
+        env["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(
+            os.path.expanduser("~"), ".cache", "delphi_tpu_bench_cache")
     # per-phase heartbeats on the child's stderr: a killed run's tail then
     # names the phase it died in (persisted into backend_fallback below)
     env.setdefault("DELPHI_PHASE_HEARTBEAT", "1")
@@ -442,9 +529,26 @@ def main() -> None:
                              "long --scale runs become observable mid-flight")
     parser.add_argument("--backend", choices=["auto", "tpu", "cpu"],
                         default="auto")
+    cache = parser.add_mutually_exclusive_group()
+    cache.add_argument("--cold", dest="cache_mode", action="store_const",
+                       const="cold", default="inherit",
+                       help="run against a fresh empty compile cache "
+                            "(measures full-compilation cost)")
+    cache.add_argument("--warm", dest="cache_mode", action="store_const",
+                       const="warm",
+                       help="run against a persistent shared compile cache "
+                            "(~/.cache/delphi_tpu_bench_cache): the second "
+                            "back-to-back run skips compilation")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny in-process CPU double-run asserting the "
+                             "warm run records compile_cache.hits > 0; "
+                             "exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
+
+    if args.smoke:
+        sys.exit(smoke())
 
     if args._child:
         _child_main(args)

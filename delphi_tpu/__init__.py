@@ -58,13 +58,19 @@ if _os.environ.get("DELPHI_XLA_CACHE", "1") != "0":
             (_os.environ.get("XLA_FLAGS", "") + "|"
              + _os.environ.get("JAX_PLATFORMS", "") + "|"
              + _cpu).encode()).hexdigest()[:12]
-        _cache_dir = _os.environ.get(
-            "DELPHI_XLA_CACHE_DIR",
-            _os.path.join(_os.path.expanduser("~"), ".cache",
-                          f"delphi_tpu_xla_{_fingerprint}"))
+        # DELPHI_COMPILE_CACHE_DIR pins an explicit, fingerprint-free dir
+        # (the compile plane's knob — callers who set it own the config
+        # scoping); DELPHI_XLA_CACHE_DIR is the legacy spelling.
+        _cache_dir = _os.environ.get("DELPHI_COMPILE_CACHE_DIR") \
+            or _os.environ.get(
+                "DELPHI_XLA_CACHE_DIR",
+                _os.path.join(_os.path.expanduser("~"), ".cache",
+                              f"delphi_tpu_xla_{_fingerprint}"))
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           float(_os.environ.get(
+                               "DELPHI_COMPILE_CACHE_MIN_S", 1)))
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass

@@ -61,6 +61,19 @@ def main(argv=None) -> int:
                              "and resource sampler; 0 picks an ephemeral "
                              "port (printed on stderr). Equivalent to "
                              "DELPHI_METRICS_PORT")
+    parser.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                        type=str, default="",
+                        help="persistent XLA compile-cache directory: the "
+                             "second run of the same shapes skips "
+                             "compilation entirely. Equivalent to "
+                             "DELPHI_COMPILE_CACHE_DIR / the "
+                             "repair.compile.cache_dir session option")
+    parser.add_argument("--pipeline", dest="pipeline",
+                        choices=["on", "off", "auto"], default="auto",
+                        help="host/device pipelined training executor: "
+                             "'auto' (default) enables it on non-CPU "
+                             "backends. Equivalent to DELPHI_PIPELINE / "
+                             "repair.pipeline.enabled")
     args = parser.parse_args(argv)
 
     # multi-host: join the cluster before any backend use (no-op when
@@ -72,6 +85,10 @@ def main(argv=None) -> int:
     recorder = None
     if args.metrics_port is not None:
         session.conf["repair.metrics.port"] = str(args.metrics_port)
+    if args.compile_cache_dir:
+        session.conf["repair.compile.cache_dir"] = args.compile_cache_dir
+    if args.pipeline != "auto":
+        session.conf["repair.pipeline.enabled"] = args.pipeline
     if args.metrics_out or args.metrics_port is not None:
         # The recorder opens here, before ingestion, so ingest.* metrics land
         # in the report (and the live server covers the whole batch run);

@@ -826,17 +826,23 @@ class RepairModel:
                     models[y] = (model, feature_map[y], transformer_map[y])
             return models
 
-        for y in pending:
-            index = len(models) + 1
-            prep = self._prepare_training_task(
+        def _prep_target(y: str) -> Any:
+            # host featurization only (lazy decode + fit-encode); under the
+            # pipeline this overlaps the previous target's device training
+            return self._prepare_training_task(
                 y, masked, float_cols, continuous_columns, feature_map,
                 transformer_map)
+
+        def _train_target(y: str, prep: Any) -> None:
+            # runs in target order on the calling thread: progress logs and
+            # the models dict mutate exactly as the sequential loop's
+            index = len(models) + 1
             if prep is None:
                 _logger.info(
                     "Skipping {}/{} model... type=classfier y={} num_class={}".format(
                         index, len(target_columns), y, num_class_map[y]))
                 models[y] = (PoorModel(None), feature_map[y], None)
-                continue
+                return
             X, y_, n_rows = prep
             is_discrete = y not in continuous_columns
             model_type = "classfier" if is_discrete else "regressor"
@@ -852,6 +858,9 @@ class RepairModel:
             _logger.info(
                 f"Finishes building '{y}' model...  score={score} elapsed={elapsed}s")
             models[y] = (model, feature_map[y], transformer_map[y])
+
+        from delphi_tpu.parallel.pipeline import run_pipelined
+        run_pipelined(pending, _prep_target, _train_target)
         return models
 
     def _build_stat_models_sharded(
@@ -2293,11 +2302,25 @@ class RepairModel:
             "mode": (selected[0] if selected else "repair_candidates"),
         })
 
-        with profile_trace("delphi.repair.run"):
-            df, elapsed = self._run(
-                table, input_name, continuous_columns, detect_errors_only,
-                compute_repair_candidate_prob, compute_repair_prob,
-                compute_repair_score, repair_data, maximal_likelihood_repair)
+        # compile plane: cache config + AOT shape-grid prewarm start here,
+        # so the training variants compile in the background while error
+        # detection and domain analysis still run
+        from delphi_tpu.parallel import compile_plane
+        prewarm = compile_plane.maybe_start_prewarm(
+            table, continuous_columns, self._row_id, self.targets,
+            int(self._get_option_value(*self._opt_max_training_row_num)),
+            self.opts)
+
+        try:
+            with profile_trace("delphi.repair.run"):
+                df, elapsed = self._run(
+                    table, input_name, continuous_columns, detect_errors_only,
+                    compute_repair_candidate_prob, compute_repair_prob,
+                    compute_repair_score, repair_data,
+                    maximal_likelihood_repair)
+        finally:
+            if prewarm is not None:
+                prewarm.stop()
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
         run_info["elapsed_s"] = round(elapsed, 6)
         run_info["result_rows"] = int(len(df))

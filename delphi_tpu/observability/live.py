@@ -385,7 +385,11 @@ def _install_compile_listener() -> None:
         from jax import monitoring
 
         def _on_duration(event: str, duration: float, **kw: Any) -> None:
-            if "compil" not in event:
+            # only actual compile-path durations (trace, jaxpr->MLIR,
+            # backend compile); the /jax/compilation_cache/* bookkeeping
+            # durations (time SAVED, retrieval) land in the compile-plane's
+            # own compile_cache.* histograms and would inflate this one
+            if not event.startswith("/jax/core/compile"):
                 return
             from delphi_tpu.observability import spans
 

@@ -212,6 +212,14 @@ def start_recording(name: str,
     except Exception as e:
         # Telemetry must never take the run down with it.
         _logger.warning(f"live telemetry plane failed to start: {e}")
+    try:
+        # compile plane: apply cache-dir/threshold overrides and forward
+        # jax compilation-cache events into this run's metrics registry
+        from delphi_tpu.parallel import compile_plane
+        compile_plane.configure_cache()
+        compile_plane.install_cache_listeners()
+    except Exception as e:
+        _logger.warning(f"compile-plane telemetry failed to start: {e}")
     return _current
 
 
@@ -219,6 +227,12 @@ def stop_recording(recorder: Optional[RunRecorder]) -> None:
     global _current
     if recorder is None:
         return
+    try:
+        # snapshot compile-cache dir size/entries into the final report
+        from delphi_tpu.parallel import compile_plane
+        compile_plane.record_cache_dir_stats()
+    except Exception as e:
+        _logger.warning(f"compile-cache stats unavailable: {e}")
     recorder.finish()
     if recorder.live is not None:
         try:

@@ -111,10 +111,14 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
             total = int(sum(counts))
             if total > APPROX_PERCENTILE_SAMPLE and len(pool):
                 if not approx:
-                    _logger.info(
-                        f"{attr}: process-local fences come from the "
-                        "row-weighted sampled pool (the reference's "
-                        "distributed approx_percentile semantics)")
+                    # the user asked for EXACT fences
+                    # (approx_enabled=False), but the sharded path cannot
+                    # gather the full pool — warn, not inform
+                    _logger.warning(
+                        f"{attr}: approx_enabled=False overridden — "
+                        "process-local fences come from the row-weighted "
+                        "sampled pool (the reference's distributed "
+                        "approx_percentile semantics)")
                 quota = max(1, int(round(
                     APPROX_PERCENTILE_SAMPLE * len(pool) / total)))
                 rng = np.random.RandomState(42)

@@ -26,6 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from delphi_tpu.table import EncodedTable
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+# One-shot marker for the multi-process lower-bound trace (see
+# `_merge_global_many`); module-level so it logs once per process, not once
+# per stats instance.
+_lower_bound_logged = False
 
 Pair = Tuple[str, str]
 
@@ -317,7 +325,18 @@ class PairDistinctCounter:
         pair."""
         if not getattr(self._table, "process_local", False) or not counts:
             return list(counts)
-        from delphi_tpu.parallel.distributed import allgather_max
+        from delphi_tpu.parallel.distributed import (allgather_max,
+                                                     process_count)
+        global _lower_bound_logged
+        if not _lower_bound_logged and process_count() > 1:
+            # one-time trace marker: multi-process distinct-pair counts are
+            # a max-over-shards LOWER BOUND, so candidate selection can
+            # diverge from a single-process run of the same data
+            _lower_bound_logged = True
+            _logger.info(
+                f"distinct-pair counts on {process_count()} processes use "
+                "the max-over-shards lower bound; functional-dependency "
+                "candidate selection may differ from a single-process run")
         return [int(c) for c in
                 allgather_max(np.asarray(counts, dtype=np.int64))]
 

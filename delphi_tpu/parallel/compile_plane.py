@@ -1,0 +1,436 @@
+"""Compile-and-dispatch plane: persistent XLA compile cache + AOT prewarm.
+
+Compilation dominates small-table TPU runs: every padded GBDT shape variant
+pays a full XLA compile the first time it launches, serialized against the
+pipeline. This module takes that cost off the critical path twice over:
+
+1. **Persistent compilation cache** — ``DELPHI_COMPILE_CACHE_DIR`` (env) or
+   ``repair.compile.cache_dir`` (session config) points
+   ``jax_compilation_cache_dir`` at a durable directory (layered over the
+   fingerprinted default the package picks at import, see
+   ``delphi_tpu/__init__.py``), and jax.monitoring cache events are forwarded
+   into the run's metrics registry as ``compile_cache.hits`` /
+   ``compile_cache.misses`` / ``compile_cache.requests`` counters plus
+   retrieval/saved-time histograms, so the run report shows exactly how much
+   compile time the cache returned. ``DELPHI_COMPILE_CACHE_MIN_S`` /
+   ``repair.compile.min_compile_secs`` lowers the persistence threshold
+   (the smoke bench sets 0 so even sub-second CPU compiles persist).
+
+2. **AOT shape-grid prewarm** — the GBDT training shapes are fully
+   enumerable before training starts: power-of-two/2048-step row targets
+   (`train_row_target`), 8-multiple feature pads, objective/class buckets
+   ({binary, multiclass×{4,8}, regression}), CV slab widths (`_CV_INSTANCE_CAP`
+   slices), and per-(depth, rounds) config-group widths from the search grid.
+   :func:`maybe_start_prewarm` derives the reachable variants from the
+   validated input table and lowers+compiles them on ONE background daemon
+   thread while ingest/detect still run, so the train phase starts against a
+   warm executable cache. The thread shuts down on the first error (a wrong
+   plan must not keep burning compile threads) and always honors
+   :meth:`PrewarmHandle.stop`.
+
+Everything here is observability-grade: failures log and degrade, never
+propagate into the run.
+"""
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("0", "false", "off", "no")
+
+# jax.monitoring event name -> metrics-registry counter. The names are
+# jax-internal but stable across the 0.4.x line; unknown events no-op.
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache.hits",
+    "/jax/compilation_cache/cache_misses": "compile_cache.misses",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "compile_cache.requests",
+}
+_DURATION_HISTOGRAMS = {
+    "/jax/compilation_cache/cache_retrieval_time_sec":
+        "compile_cache.retrieval_seconds",
+    "/jax/compilation_cache/compile_time_saved_sec":
+        "compile_cache.saved_seconds",
+}
+
+_listener_lock = threading.Lock()
+_listeners_installed = False
+_configured_dir: Optional[str] = None
+
+
+def _conf(key: str) -> Optional[str]:
+    try:
+        from delphi_tpu.session import get_session
+        raw = get_session().conf.get(key)
+        return str(raw) if raw is not None and str(raw).strip() else None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Persistent cache wiring + telemetry
+# ---------------------------------------------------------------------------
+
+def configure_cache() -> Optional[str]:
+    """Applies the run-level compile-cache overrides on top of the
+    import-time default: cache directory (env beats session config) and the
+    minimum-compile-time persistence threshold. Returns the effective cache
+    directory (None when persistent caching is off entirely)."""
+    global _configured_dir
+    try:
+        import jax
+    except Exception:
+        return None
+    try:
+        current = jax.config.jax_compilation_cache_dir
+    except Exception:
+        current = None
+    target = os.environ.get("DELPHI_COMPILE_CACHE_DIR") \
+        or _conf("repair.compile.cache_dir")
+    if target:
+        target = os.path.abspath(os.path.expanduser(str(target)))
+        if target != current:
+            try:
+                os.makedirs(target, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", target)
+                # jax binds its persistent-cache object to the directory
+                # configured at FIRST use and ignores later config updates;
+                # reset so the run-level override genuinely re-points disk
+                # reads/writes
+                try:
+                    from jax._src import compilation_cache as _cc
+                    _cc.reset_cache()
+                except Exception:
+                    pass
+                _logger.info(f"persistent compile cache: {target}")
+                current = target
+            except Exception as e:
+                _logger.warning(
+                    f"cannot use compile cache dir {target}: {e}")
+    min_s = os.environ.get("DELPHI_COMPILE_CACHE_MIN_S")
+    if min_s is None or not str(min_s).strip():
+        min_s = _conf("repair.compile.min_compile_secs")
+    if min_s is not None:
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_s))
+        except Exception as e:
+            _logger.warning(
+                f"invalid compile-cache min-seconds {min_s!r}: {e}")
+    _configured_dir = current
+    return current
+
+
+def install_cache_listeners() -> None:
+    """Forwards jax.monitoring compilation-cache events into the ACTIVE
+    run's metrics registry. Installed once per process (jax offers no
+    unregister), the forwarding closures read the current recorder at fire
+    time — runs without a recorder cost one dict probe per event."""
+    global _listeners_installed
+    with _listener_lock:
+        if _listeners_installed:
+            return
+        _listeners_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kw: Any) -> None:
+            name = _EVENT_COUNTERS.get(event)
+            if name is None:
+                return
+            from delphi_tpu.observability import spans
+            rec = spans._current
+            if rec is not None:
+                rec.registry.inc(name)
+
+        def _on_duration(event: str, duration: float, **kw: Any) -> None:
+            name = _DURATION_HISTOGRAMS.get(event)
+            if name is None:
+                return
+            from delphi_tpu.observability import spans
+            rec = spans._current
+            if rec is not None:
+                rec.registry.observe(name, duration)
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:
+        _logger.debug(f"compile-cache listeners unavailable: {e}")
+
+
+def record_cache_dir_stats() -> None:
+    """Snapshots the cache directory's entry count and total bytes into the
+    active registry (``compile_cache.entries`` / ``compile_cache.dir_bytes``
+    gauges) — jax emits no size events, so the plane walks the directory.
+    No-op (and no disk walk) without an active recorder."""
+    from delphi_tpu.observability import spans
+    if spans._current is None:
+        return
+    d = _configured_dir
+    if d is None:
+        try:
+            import jax
+            d = jax.config.jax_compilation_cache_dir
+        except Exception:
+            d = None
+    if not d or not os.path.isdir(d):
+        return
+    total = 0
+    entries = 0
+    try:
+        with os.scandir(d) as it:
+            for entry in it:
+                if entry.is_file(follow_symlinks=False):
+                    entries += 1
+                    total += entry.stat(follow_symlinks=False).st_size
+    except OSError:
+        return
+    from delphi_tpu.observability import gauge_set
+    gauge_set("compile_cache.entries", entries)
+    gauge_set("compile_cache.dir_bytes", total)
+
+
+# ---------------------------------------------------------------------------
+# AOT shape-grid prewarm
+# ---------------------------------------------------------------------------
+
+class PrewarmHandle:
+    """Owns the background prewarm thread; ``stop()`` is safe to call any
+    number of times and after natural completion."""
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.compiled = 0
+        self.planned = 0
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        """Signals the worker to stop after its in-flight compile and
+        optionally waits for it. The thread is a daemon: a worker stuck
+        inside one XLA compile past ``timeout`` cannot block the run."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and timeout:
+            t.join(timeout)
+
+
+def _prewarm_worker(handle: PrewarmHandle,
+                    variants: List[Dict[str, Any]]) -> None:
+    from delphi_tpu.observability import counter_inc, histogram_observe
+    for v in variants:
+        if handle._stop.is_set():
+            break
+        t0 = time.perf_counter()
+        try:
+            from delphi_tpu.models.gbdt import aot_compile_cv_chunk
+            aot_compile_cv_chunk(**v)
+        except BaseException as e:
+            # shutdown on first error: a variant that won't lower means the
+            # plan disagrees with the kernels (shape drift, backend hiccup)
+            # — record it and leave the real shapes to plain JIT
+            handle.error = e
+            counter_inc("compile_plane.prewarm_errors")
+            _logger.warning(
+                f"AOT prewarm stopped on {v}: {type(e).__name__}: {e}")
+            break
+        handle.compiled += 1
+        counter_inc("compile_plane.prewarmed")
+        histogram_observe("compile_plane.prewarm_seconds",
+                          time.perf_counter() - t0)
+    record_cache_dir_stats()
+
+
+def start_prewarm(variants: List[Dict[str, Any]]) -> PrewarmHandle:
+    handle = PrewarmHandle()
+    handle.planned = len(variants)
+    if variants:
+        t = threading.Thread(target=_prewarm_worker,
+                             args=(handle, list(variants)),
+                             daemon=True, name="delphi-aot-prewarm")
+        handle._thread = t
+        t.start()
+    return handle
+
+
+def prewarm_enabled() -> bool:
+    """``DELPHI_PREWARM`` env / ``repair.compile.prewarm`` config; the auto
+    default prewarns only off-host devices — on the CPU backend the compile
+    threads would steal the very cores the pipeline computes on."""
+    raw = os.environ.get("DELPHI_PREWARM")
+    if raw is None:
+        raw = _conf("repair.compile.prewarm")
+    if raw is not None:
+        v = str(raw).strip().lower()
+        if v in _TRUTHY:
+            return True
+        if v in _FALSY:
+            return False
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _prewarm_budget() -> int:
+    raw = os.environ.get("DELPHI_PREWARM_BUDGET") \
+        or _conf("repair.compile.prewarm_budget")
+    try:
+        return max(0, int(raw)) if raw is not None else 32
+    except (TypeError, ValueError):
+        _logger.warning(f"invalid prewarm budget {raw!r}; using 32")
+        return 32
+
+
+def plan_prewarm_variants(table: Any, continuous_columns: List[str],
+                          row_id: str, targets: Optional[List[str]],
+                          max_training_rows: int,
+                          opts: Dict[str, str]) -> List[Dict[str, Any]]:
+    """Enumerates the padded CV-chunk shape variants phase 2 can reach,
+    from facts that are static once the input table is validated: row/
+    feature pad targets, per-column objective/class buckets, the trimmed
+    search grid's (depth, rounds) config groups, and the slab widths the
+    batched search will stack. Mesh lowering is not prewarmed yet — with an
+    active mesh the plan is empty."""
+    import jax
+
+    from delphi_tpu import train as _train
+    from delphi_tpu.models import gbdt as _gbdt
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    from delphi_tpu.utils import get_option_value
+
+    if get_active_mesh() is not None:
+        return []
+    cpu = jax.default_backend() == "cpu"
+    n_rows = int(table.n_rows)
+    columns = [c for c in table.column_names if c != row_id]
+    if targets:
+        wanted = set(targets)
+        columns = [c for c in columns if c in wanted]
+    domain = table.domain_stats()
+    continuous = set(continuous_columns)
+
+    n_splits = int(get_option_value(opts, *_train._opt_n_splits))
+    max_evals = int(get_option_value(opts, *_train._opt_max_evals))
+    n_train = max(1, min(n_rows, int(max_training_rows)))
+    if n_train < n_splits * 2:
+        return []  # no CV search at this size, nothing to warm
+    n_pad = _gbdt.train_row_target(n_train, None)
+    # feature estimate: one feature column per non-target attribute (the
+    # compact GBDT design); a miss only wastes one warmed variant
+    n_feat = max(1, len(table.column_names) - 2)
+    d_pad = max(8, -(-n_feat // 8) * 8)
+    n_bins = 64  # max_bin caps at 63 (gbdt), binner width is max_bin + 1
+
+    # bucket the targets exactly like the batched search groups them:
+    # (objective, class bucket, trimmed-grid signature)
+    buckets: Dict[tuple, int] = {}
+    for c in columns:
+        is_discrete = c not in continuous
+        if is_discrete:
+            k_real = int(domain.get(c, 0))
+            if k_real <= 1:
+                continue
+            num_class = k_real
+            if k_real <= 2:
+                objective, k = "binary", 1
+            elif k_real <= _gbdt.MAX_MULTICLASS:
+                objective = "multiclass"
+                k = next(b for b in (4, 8, 16, 24, _gbdt.MAX_MULTICLASS)
+                         if b >= k_real)
+            else:
+                continue  # routed to the logistic head, not GBDT
+        else:
+            objective, k, num_class = "regression", 1, 0
+        if not _gbdt.gbdt_supported(is_discrete, num_class):
+            continue
+        grid = _train._trimmed_grid(is_discrete, num_class, max_evals,
+                                    opts, cpu)
+        if len(grid) <= 1:
+            continue  # single-config grids skip CV entirely
+        sig = tuple(tuple(sorted(cfg.items())) for cfg in grid)
+        key = (objective, k, sig)
+        buckets[key] = buckets.get(key, 0) + 1
+
+    variants: List[Dict[str, Any]] = []
+    seen = set()
+    for (objective, k, sig), n_targets in buckets.items():
+        grid = [dict(s) for s in sig]
+        groups: Dict[tuple, int] = {}
+        for cfg in grid:
+            depth = int(cfg.get("max_depth", 7))
+            rounds = _gbdt._cfg_rounds_for(cfg, objective, k)
+            groups[(depth, rounds)] = groups.get((depth, rounds), 0) + 1
+        # slab widths the search will launch: single targets keep their
+        # exact fold count, multi-target slabs pad to powers of two under
+        # the instance cap (see gbdt_cv_grid_search_multi)
+        total = n_targets * n_splits
+        cap = int(os.environ.get("DELPHI_CV_INSTANCE_CAP",
+                                 str(_gbdt._CV_INSTANCE_CAP)))
+        widths = set()
+        if n_targets == 1:
+            widths.add(n_splits)
+        else:
+            full, rem = divmod(total, cap)
+            if full:
+                widths.add(cap)
+            if rem:
+                widths.add(1 << max(0, rem - 1).bit_length())
+        for (depth, _rounds), n_cfg in groups.items():
+            for width in sorted(widths):
+                vkey = (depth, objective, k, width, n_cfg)
+                if vkey in seen:
+                    continue
+                seen.add(vkey)
+                variants.append(dict(
+                    chunk=_gbdt._CHUNK_ROUNDS, depth=depth, n_bins=n_bins,
+                    n_nodes=1 << depth, objective=objective, k=k,
+                    width=width, n_cfg=n_cfg, n_pad=n_pad, d_pad=d_pad))
+
+    budget = _prewarm_budget()
+    if len(variants) > budget:
+        _logger.info(
+            f"AOT prewarm plan truncated to budget: {budget} of "
+            f"{len(variants)} variants (DELPHI_PREWARM_BUDGET raises it)")
+        variants = variants[:budget]
+    return variants
+
+
+def maybe_start_prewarm(table: Any, continuous_columns: List[str],
+                        row_id: str, targets: Optional[List[str]],
+                        max_training_rows: int,
+                        opts: Dict[str, str]) -> Optional[PrewarmHandle]:
+    """Run-start hook: applies the cache config, installs the cache-event
+    listeners, and (when prewarm is enabled and applicable) kicks off the
+    background AOT compile of the planned shape grid. Never raises."""
+    try:
+        configure_cache()
+        install_cache_listeners()
+        if not prewarm_enabled():
+            return None
+        variants = plan_prewarm_variants(
+            table, continuous_columns, row_id, targets,
+            max_training_rows, opts)
+        if not variants:
+            return None
+        from delphi_tpu.observability import gauge_set
+        gauge_set("compile_plane.prewarm_planned", len(variants))
+        _logger.info(
+            f"AOT prewarm: compiling {len(variants)} shape variants on a "
+            "background thread")
+        return start_prewarm(variants)
+    except Exception as e:
+        _logger.warning(f"AOT prewarm unavailable: {e}")
+        return None

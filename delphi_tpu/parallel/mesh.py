@@ -1,6 +1,7 @@
 """Mesh construction and row sharding helpers."""
 
 import os
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -25,6 +26,12 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
 
 
 _active_mesh_cache: dict = {}
+
+# After this many consecutive failed backend probes, stop re-probing on
+# every stats op and only retry after a cool-down — a recovered backend
+# (e.g. a TPU tunnel coming back) is still picked up at the next window.
+_PROBE_FAILURE_LIMIT = 3
+_PROBE_RETRY_AFTER_S = 60.0
 
 _local_compute_depth = 0
 
@@ -67,17 +74,28 @@ def get_active_mesh() -> Optional[Mesh]:
     setting = setting.strip().lower()
     if setting == "":
         if "__default__" not in _active_mesh_cache:
+            retry_at = _active_mesh_cache.get("__probe_retry_at__")
+            if retry_at is not None and time.monotonic() < retry_at:
+                # backed off after repeated probe failures: answer
+                # single-device without touching the backend until the
+                # cool-down elapses
+                return None
             mesh, cacheable = _default_mesh()
             if not cacheable:
                 # transient backend-init failure: answer single-device for
-                # THIS call and retry next time — but only a few times, so a
-                # PERSISTENTLY broken backend doesn't pay a re-init attempt
-                # on every stats op for the process lifetime
+                # THIS call and retry next time — after a few consecutive
+                # failures, only retry every _PROBE_RETRY_AFTER_S so a
+                # persistently broken backend doesn't pay a re-init attempt
+                # on every stats op, while a recovered one is still found
                 fails = _active_mesh_cache.get("__probe_failures__", 0) + 1
                 _active_mesh_cache["__probe_failures__"] = fails
-                if fails < 3:
-                    return None
-                mesh = None
+                if fails >= _PROBE_FAILURE_LIMIT:
+                    _active_mesh_cache["__probe_retry_at__"] = \
+                        time.monotonic() + _PROBE_RETRY_AFTER_S
+                    _active_mesh_cache["__probe_failures__"] = 0
+                return None
+            _active_mesh_cache.pop("__probe_failures__", None)
+            _active_mesh_cache.pop("__probe_retry_at__", None)
             _active_mesh_cache["__default__"] = mesh
         return _active_mesh_cache["__default__"]
     if setting in ("0", "off", "none"):

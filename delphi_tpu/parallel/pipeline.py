@@ -1,0 +1,138 @@
+"""Bounded producer/consumer pipelining for the repair pipeline's
+host-prep / device-train overlap.
+
+The training phases alternate host-side featurization (pandas/numpy: decode
+the training sample, fit-encode features, bin/pad fold tensors) with device
+launches (CV chunks, boosting chunks). Sequentially the device idles during
+every prep and the host idles during every launch; :func:`run_pipelined`
+overlaps them with ONE background prepare thread feeding a bounded queue
+while the calling thread keeps consuming in order.
+
+Determinism contract — results must be BIT-IDENTICAL with the pipeline on
+or off, so the shape is deliberately conservative:
+
+- ``prepare`` runs in item order on the single producer thread (no
+  reordering, no multi-thread fan-out);
+- ``consume`` runs in item order on the CALLING thread (device dispatch
+  order, logging order and model-side effects are exactly the sequential
+  loop's);
+- an exception from ``prepare(k)`` or ``consume(k)`` surfaces at the same
+  item index it would have sequentially — results prepared ahead of a
+  failure are discarded, never consumed.
+
+``prepare`` must not depend on side effects of later ``consume`` calls
+(every call site here preps from inputs fixed before the loop starts).
+
+The DISABLED path is a plain sequential loop: no queue, no thread —
+``threading.active_count()`` is untouched. Toggle with ``DELPHI_PIPELINE``
+(1/0) or the ``repair.pipeline.enabled`` session config; the default
+(``auto``) enables overlap only when the device is not the host CPU, where
+producer and consumer would fight for the same cores.
+"""
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Sequence
+
+from delphi_tpu.observability import counter_inc, histogram_observe
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("0", "false", "off", "no")
+
+# How many items the producer may run ahead of the consumer. 2 is enough to
+# hide one prep behind one launch; more only grows peak host memory (each
+# queued slot holds a full prepared training set).
+_DEFAULT_DEPTH = 2
+
+
+def _flag_state() -> Any:
+    """Tri-state toggle: True/False when forced, None for auto.
+    DELPHI_PIPELINE beats the repair.pipeline.enabled session config."""
+    raw = os.environ.get("DELPHI_PIPELINE")
+    if raw is None:
+        try:
+            from delphi_tpu.session import get_session
+            raw = get_session().conf.get("repair.pipeline.enabled")
+        except Exception:
+            raw = None
+    if raw is None:
+        return None
+    v = str(raw).strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    return None
+
+
+def enabled() -> bool:
+    """Whether prep/launch overlap is on (see module docstring)."""
+    state = _flag_state()
+    if state is not None:
+        return state
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def run_pipelined(items: Sequence[Any],
+                  prepare: Callable[[Any], Any],
+                  consume: Callable[[Any, Any], Any],
+                  depth: int = _DEFAULT_DEPTH) -> List[Any]:
+    """Runs ``consume(item, prepare(item))`` over ``items``, overlapping
+    ``prepare`` of the next items with ``consume`` of the current one.
+    Returns the list of ``consume`` results, in item order."""
+    items = list(items)
+    if len(items) <= 1 or not enabled():
+        # the sequential loop IS the disabled path: zero threads, zero queues
+        return [consume(it, prepare(it)) for it in items]
+
+    counter_inc("pipeline.runs")
+    counter_inc("pipeline.items", len(items))
+    stop = threading.Event()
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+
+    def _producer() -> None:
+        for idx, it in enumerate(items):
+            if stop.is_set():
+                return
+            try:
+                prep = prepare(it)
+            except BaseException as e:
+                # delivered (and re-raised) at idx, preserving sequential
+                # error order; nothing past a failed prepare ever runs
+                q.put((idx, None, e))
+                return
+            q.put((idx, prep, None))
+
+    producer = threading.Thread(target=_producer, daemon=True,
+                                name="delphi-pipeline-prepare")
+    producer.start()
+    results: List[Any] = []
+    try:
+        for _ in range(len(items)):
+            t0 = time.perf_counter()
+            idx, prep, err = q.get()
+            histogram_observe("pipeline.consumer_wait_seconds",
+                              time.perf_counter() - t0)
+            if err is not None:
+                raise err
+            results.append(consume(items[idx], prep))
+        return results
+    finally:
+        stop.set()
+        # unblock a producer parked on a full queue, then wait for it to
+        # exit so no prepare thread outlives its call
+        while producer.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            producer.join(timeout=0.05)

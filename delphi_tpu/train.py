@@ -449,7 +449,10 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
                 sig = tuple(tuple(sorted(c.items())) for c in grid)
                 cv_sets.setdefault(sig, []).append(ti)
 
-        for tis in cv_sets.values():
+        def _prep_cv_set(tis: list) -> list:
+            # host featurization of one CV set: fold binning, padding,
+            # scoring constants (pandas/numpy only — device-free, so it can
+            # run on the pipeline's prepare thread)
             grid = chosen[tis[0]][3]
             preps = []
             for ti in tis:
@@ -463,6 +466,10 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
                 except Exception as e:
                     _logger.warning(f"{e.__class__}: {e}")
                     preps.append(None)
+            return preps
+
+        def _search_cv_set(tis: list, preps: list) -> None:
+            grid = chosen[tis[0]][3]
             remaining = 0.0 if deadline is None \
                 else max(deadline - time.monotonic(), 1e-3)
             res = gbdt_cv_grid_search_multi(
@@ -471,6 +478,10 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
                 if timed:
                     rounds = 0  # not CV-proven: keep the full round budget
                 chosen[ti] = (dict(grid[ci]), score, rounds, grid)
+
+        # featurization of CV set k+1 overlaps the device search of set k
+        from delphi_tpu.parallel.pipeline import run_pipelined
+        run_pipelined(list(cv_sets.values()), _prep_cv_set, _search_cv_set)
 
         # local refinement stays per-target (candidate neighborhoods
         # diverge), but only for targets the base grid left below the

@@ -1,0 +1,144 @@
+"""Tests for the compile plane (delphi_tpu/parallel/compile_plane.py):
+persistent-cache counters, AOT prewarm lifecycle, and the mesh probe
+backoff satellite."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from delphi_tpu import observability as obs
+from delphi_tpu.parallel import compile_plane
+
+
+@pytest.fixture
+def restore_cache_config():
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
+def test_cache_hit_miss_counters_across_two_runs(tmp_path, monkeypatch,
+                                                 restore_cache_config):
+    monkeypatch.setenv("DELPHI_COMPILE_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("DELPHI_COMPILE_CACHE_MIN_S", "0")
+
+    @jax.jit
+    def f(x):
+        return (x * 2.0 + 1.0).sum()
+
+    rec1 = obs.start_recording("compile_plane.run1")
+    assert rec1 is not None
+    try:
+        # start_recording applied the env overrides via configure_cache
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "cache")
+        jax.block_until_ready(f(jnp.arange(17.0)))
+    finally:
+        obs.stop_recording(rec1)
+
+    # drop in-memory executables: the second run must go to the dir
+    jax.clear_caches()
+
+    rec2 = obs.start_recording("compile_plane.run2")
+    try:
+        jax.block_until_ready(f(jnp.arange(17.0)))
+    finally:
+        obs.stop_recording(rec2)
+
+    c1 = rec1.registry.snapshot()["counters"]
+    c2 = rec2.registry.snapshot()["counters"]
+    assert c1.get("compile_cache.misses", 0) > 0
+    assert c1.get("compile_cache.hits", 0) == 0
+    assert c2.get("compile_cache.hits", 0) > 0
+    # the warm report also carries the cache-dir size gauges
+    g2 = rec2.registry.snapshot()["gauges"]
+    assert g2.get("compile_cache.entries", 0) > 0
+    assert g2.get("compile_cache.dir_bytes", 0) > 0
+
+
+def test_prewarm_thread_shuts_down_on_error(monkeypatch):
+    from delphi_tpu.models import gbdt
+    calls = []
+
+    def boom(**kw):
+        calls.append(kw)
+        raise RuntimeError("lowering failed")
+
+    monkeypatch.setattr(gbdt, "aot_compile_cv_chunk", boom)
+    handle = compile_plane.start_prewarm([{"marker": 1}, {"marker": 2}])
+    handle._thread.join(timeout=30)
+    assert not handle.alive
+    assert isinstance(handle.error, RuntimeError)
+    assert handle.compiled == 0
+    assert len(calls) == 1  # stopped on the FIRST error, second never ran
+
+
+def test_prewarm_stop_interrupts_pending_variants(monkeypatch):
+    from delphi_tpu.models import gbdt
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow(**kw):
+        started.set()
+        release.wait(timeout=30)
+
+    monkeypatch.setattr(gbdt, "aot_compile_cv_chunk", slow)
+    handle = compile_plane.start_prewarm([{"m": i} for i in range(50)])
+    assert started.wait(timeout=30)
+    handle.stop(timeout=0.1)  # signal while variant 0 is in flight
+    release.set()
+    handle._thread.join(timeout=30)
+    assert not handle.alive
+    assert handle.error is None
+    assert handle.compiled < 50
+
+
+def test_prewarm_compiles_planned_variant():
+    handle = compile_plane.start_prewarm([dict(
+        chunk=25, depth=3, n_bins=64, n_nodes=8, objective="binary", k=1,
+        width=2, n_cfg=1, n_pad=32, d_pad=8)])
+    handle._thread.join(timeout=120)
+    assert handle.error is None
+    assert handle.compiled == 1
+
+
+def test_empty_prewarm_plan_spawns_no_thread():
+    before = threading.active_count()
+    handle = compile_plane.start_prewarm([])
+    assert not handle.alive
+    assert threading.active_count() == before
+    handle.stop()  # must be safe with no thread
+
+
+def test_mesh_probe_failure_backs_off_then_recovers(monkeypatch):
+    from delphi_tpu.parallel import mesh
+    probes = []
+
+    def failing_probe():
+        probes.append(1)
+        return None, False
+
+    monkeypatch.setattr(mesh, "_default_mesh", failing_probe)
+    monkeypatch.setattr(mesh, "_active_mesh_cache", {})
+    monkeypatch.setenv("DELPHI_MESH", "")
+
+    for _ in range(mesh._PROBE_FAILURE_LIMIT):
+        assert mesh.get_active_mesh() is None
+    assert len(probes) == mesh._PROBE_FAILURE_LIMIT
+    # backed off: inside the cool-down window no further probe runs and
+    # the failure is NOT latched as the permanent default
+    assert mesh.get_active_mesh() is None
+    assert len(probes) == mesh._PROBE_FAILURE_LIMIT
+    assert "__default__" not in mesh._active_mesh_cache
+
+    # cool-down elapses and the backend has recovered: the next call
+    # probes again and caches the (successful) answer for good
+    mesh._active_mesh_cache["__probe_retry_at__"] = time.monotonic() - 1.0
+    monkeypatch.setattr(mesh, "_default_mesh", lambda: (None, True))
+    assert mesh.get_active_mesh() is None
+    assert "__default__" in mesh._active_mesh_cache
+    assert "__probe_retry_at__" not in mesh._active_mesh_cache

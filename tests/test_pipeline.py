@@ -1,0 +1,115 @@
+"""Tests for the pipelined executor (delphi_tpu/parallel/pipeline.py):
+determinism contract, thread hygiene, and end-to-end repair parity."""
+
+import threading
+
+import pandas as pd
+import pytest
+
+from delphi_tpu.parallel.pipeline import enabled, run_pipelined
+
+
+def _no_pipeline_threads() -> bool:
+    return not any(t.name == "delphi-pipeline-prepare"
+                   for t in threading.enumerate())
+
+
+def test_disabled_path_spawns_no_threads(monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "0")
+    assert not enabled()
+    before = threading.active_count()
+    out = run_pipelined([1, 2, 3], lambda x: x * 10,
+                        lambda item, prep: prep + item)
+    assert out == [11, 22, 33]
+    assert threading.active_count() == before
+    assert _no_pipeline_threads()
+
+
+def test_enabled_path_preserves_order_and_results(monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "1")
+    assert enabled()
+    consumed = []
+
+    def prep(x):
+        return x * 10
+
+    def consume(item, p):
+        consumed.append(item)
+        return p + item
+
+    out = run_pipelined(list(range(6)), prep, consume)
+    assert out == [0, 11, 22, 33, 44, 55]
+    assert consumed == list(range(6))
+    assert _no_pipeline_threads()
+
+
+def test_enabled_path_single_item_stays_sequential(monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "1")
+    before = threading.active_count()
+    assert run_pipelined([7], lambda x: x, lambda i, p: p) == [7]
+    assert threading.active_count() == before
+
+
+def test_prepare_error_surfaces_at_sequential_index(monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "1")
+    consumed = []
+
+    def prep(x):
+        if x == 2:
+            raise ValueError("boom")
+        return x
+
+    def consume(item, p):
+        consumed.append(item)
+        return p
+
+    with pytest.raises(ValueError, match="boom"):
+        run_pipelined([0, 1, 2, 3], prep, consume)
+    # items before the failure consumed in order; nothing past it ran
+    assert consumed == [0, 1]
+    assert _no_pipeline_threads()
+
+
+def test_consume_error_stops_producer(monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "1")
+
+    def consume(item, p):
+        if item == 1:
+            raise RuntimeError("consumer failed")
+        return p
+
+    with pytest.raises(RuntimeError, match="consumer failed"):
+        run_pipelined(list(range(50)), lambda x: x, consume)
+    assert _no_pipeline_threads()
+
+
+def _tiny_dirty_frame() -> pd.DataFrame:
+    n = 48
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    })
+    df.loc[df.index % 9 == 0, "c1"] = None
+    return df
+
+
+def _repair(session, name: str) -> pd.DataFrame:
+    from delphi_tpu import NullErrorDetector, delphi
+    session.register(name, _tiny_dirty_frame())
+    out = delphi.repair \
+        .setTableName(name) \
+        .setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .run()
+    return out.sort_values(list(out.columns)).reset_index(drop=True)
+
+
+def test_repair_bit_identical_with_pipeline_on_and_off(session, monkeypatch):
+    monkeypatch.setenv("DELPHI_PIPELINE", "0")
+    off = _repair(session, "pipe_off")
+    monkeypatch.setenv("DELPHI_PIPELINE", "1")
+    on = _repair(session, "pipe_on")
+    pd.testing.assert_frame_equal(off, on)
+    assert _no_pipeline_threads()
