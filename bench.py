@@ -68,6 +68,9 @@ def _run_report_path() -> str:
         path = os.path.join(
             tempfile.mkdtemp(prefix="delphi_report_"), "run_report.json")
         os.environ["DELPHI_METRICS_PATH"] = path
+    # in-memory provenance ledger so the report carries per-attribute
+    # scorecards (repair rate / confidence) without ledger file I/O
+    os.environ.setdefault("DELPHI_PROVENANCE_PATH", ":memory:")
     return path
 
 
@@ -134,7 +137,9 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
 
     cells_per_sec = len(repaired) / elapsed if elapsed > 0 else 0.0
     extra = util.stop(elapsed) if util is not None else {}
-    from delphi_tpu.observability import bench_entry, load_run_report
+    from delphi_tpu.observability import (bench_entry, load_run_report,
+                                          scorecard_summary)
+    report = load_run_report(report_path)
     print(json.dumps(bench_entry(
         "hospital_scale_cells_repaired_per_sec",
         round(cells_per_sec, 1), "cells/s",
@@ -146,9 +151,10 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
             "elapsed_s": round(elapsed, 3),
             "device": device,
             "peak_rss_gb": _peak_rss_gb(),
+            "scorecards": scorecard_summary((report or {}).get("scorecards")),
             **extra,
         },
-        run_report=load_run_report(report_path))), flush=True)
+        run_report=report)), flush=True)
 
 
 def flights(scale: int, profile: bool = False) -> None:
@@ -211,7 +217,9 @@ def flights(scale: int, profile: bool = False) -> None:
         .run()
     elapsed = time.time() - t0
 
-    from delphi_tpu.observability import bench_entry, load_run_report
+    from delphi_tpu.observability import (bench_entry, load_run_report,
+                                          scorecard_summary)
+    report = load_run_report(report_path)
     result = bench_entry(
         "flights_e2e_repair_wall_time", round(elapsed, 3), "s",
         extra={
@@ -223,8 +231,9 @@ def flights(scale: int, profile: bool = False) -> None:
             if elapsed else 0.0,
             "device": device,
             "peak_rss_gb": _peak_rss_gb(),
+            "scorecards": scorecard_summary((report or {}).get("scorecards")),
         },
-        run_report=load_run_report(report_path))
+        run_report=report)
     if util is not None:
         result.update(util.stop(elapsed))
 

@@ -29,7 +29,7 @@ from delphi_tpu.ops.entropy import compute_pairwise_stats, select_candidate_pair
 from delphi_tpu.ops.freq import FreqStats, PairDistinctCounter, compute_freq_stats
 from delphi_tpu.session import get_session
 from delphi_tpu.table import DiscretizedTable, EncodedTable, discretize_table
-from delphi_tpu.observability import counter_inc, gauge_set
+from delphi_tpu.observability import active_ledger, counter_inc, gauge_set
 from delphi_tpu.utils import (
     get_option_value, job_phase, log_based_on_level, setup_logger, to_list_str)
 
@@ -473,11 +473,17 @@ class ErrorModel:
                            for d in detectors)
         self._non_constraint_frames = [] if keep_capture else None
         self._non_constraint_cells_cache = None
+        led = active_ledger()
         for d in detectors:
             d.setUp(self.row_id, input_name, continuous_columns, target_attrs,
                     encoded_table=table)
             cells = d.detect()
             frames.append(cells)
+            if led is not None and len(cells):
+                led.record_detection(
+                    str(d), cells[ROW_IDX].to_numpy(),
+                    cells["attribute"].to_numpy(dtype=object),
+                    cells[self.row_id].to_numpy())
             if keep_capture and len(cells) \
                     and not isinstance(d, ConstraintErrorDetector):
                 assert self._non_constraint_frames is not None
@@ -533,6 +539,12 @@ class ErrorModel:
             idx = np.where(idx >= 0, idx, lut[miss_codes])
         df = df.assign(**{ROW_IDX: idx})
         df = df[df[ROW_IDX] >= 0].reset_index(drop=True)
+        led = active_ledger()
+        if led is not None and len(df):
+            led.record_detection(
+                "user_supplied", df[ROW_IDX].to_numpy(),
+                df["attribute"].to_numpy(dtype=object),
+                df[self.row_id].to_numpy())
         return df
 
     def _with_current_values(self, table: EncodedTable, cells_df: pd.DataFrame,
@@ -583,6 +595,12 @@ class ErrorModel:
             noisy_columns = list(factorized[1])
             noisy_cells_df = self._with_current_values(
                 table, noisy_cells_df, factorized=factorized)
+            led = active_ledger()
+            if led is not None:
+                led.record_current_values(
+                    noisy_cells_df[self.row_id].to_numpy(),
+                    noisy_cells_df["attribute"].to_numpy(dtype=object),
+                    noisy_cells_df["current_value"].to_numpy(dtype=object))
         if table.process_local:
             # the target-column set must be identical on every process (it
             # drives the collective sequence of phases 1b-2): union the
@@ -656,6 +674,11 @@ class ErrorModel:
             self._get_option_value(*self._opt_domain_threshold_alpha),
             self._get_option_value(*self._opt_domain_threshold_beta))
         fixed = int(demote.sum())
+        led = active_ledger()
+        if led is not None and fixed:
+            led.record_weak_label_demotions(
+                noisy_cells_df[self.row_id].to_numpy()[demote],
+                attrs_np[demote])
         error_cells_df = noisy_cells_df[~demote].reset_index(drop=True)
         assert len(noisy_cells_df) == len(error_cells_df) + fixed
         counter_inc("domain.cells_fixed", fixed)

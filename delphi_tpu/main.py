@@ -6,6 +6,11 @@
 Reads a CSV (or a name already registered in the session catalog), runs the
 repair pipeline, and writes the result CSV. `--detect-only` emits the error
 cells instead of repairs; `--constraints` wires a ConstraintErrorDetector.
+
+Observability: `--metrics-out`/`--metrics-port` cover the run report and
+live telemetry; `--provenance-out` records the per-cell repair provenance
+ledger; `--baseline-report` runs the cross-run drift gate against a prior
+run report (exit code 3 when `--drift-fail-over` trips).
 """
 
 import argparse
@@ -74,6 +79,28 @@ def main(argv=None) -> int:
                              "'auto' (default) enables it on non-CPU "
                              "backends. Equivalent to DELPHI_PIPELINE / "
                              "repair.pipeline.enabled")
+    parser.add_argument("--provenance-out", dest="provenance_out", type=str,
+                        default="",
+                        help="write the per-cell repair provenance ledger "
+                             "(JSONL: detector, domain size, top-k "
+                             "posterior, decision) to this path; ':memory:' "
+                             "keeps it in-process for the run-report "
+                             "scorecards only. Equivalent to "
+                             "DELPHI_PROVENANCE_PATH / "
+                             "repair.provenance.path")
+    parser.add_argument("--baseline-report", dest="baseline_report", type=str,
+                        default="",
+                        help="prior run-report JSON to compare this run's "
+                             "per-attribute scorecards against (PSI on "
+                             "confidence histograms, Jensen-Shannon on "
+                             "repaired-value distributions); implies an "
+                             "in-memory provenance ledger and emits drift.* "
+                             "gauges")
+    parser.add_argument("--drift-fail-over", dest="drift_fail_over",
+                        type=float, default=None,
+                        help="fail the run (exit code 3) when the max "
+                             "drift divergence vs --baseline-report exceeds "
+                             "this value")
     args = parser.parse_args(argv)
 
     # multi-host: join the cluster before any backend use (no-op when
@@ -89,7 +116,15 @@ def main(argv=None) -> int:
         session.conf["repair.compile.cache_dir"] = args.compile_cache_dir
     if args.pipeline != "auto":
         session.conf["repair.pipeline.enabled"] = args.pipeline
-    if args.metrics_out or args.metrics_port is not None:
+    if args.provenance_out:
+        session.conf["repair.provenance.path"] = args.provenance_out
+    elif args.baseline_report:
+        # the drift gate needs this run's scorecards, which come from the
+        # provenance ledger; an in-memory ledger costs no file I/O
+        from delphi_tpu.observability.provenance import MEMORY_PATH
+        session.conf.setdefault("repair.provenance.path", MEMORY_PATH)
+    if args.metrics_out or args.metrics_port is not None \
+            or args.provenance_out or args.baseline_report:
         # The recorder opens here, before ingestion, so ingest.* metrics land
         # in the report (and the live server covers the whole batch run);
         # the nested run() sees an active recorder, records into the same
@@ -136,6 +171,7 @@ def main(argv=None) -> int:
         model = model.setTargets(args.targets.split(","))
 
     status, error = "ok", None
+    drift_result = None
     try:
         result = model.run(detect_errors_only=args.detect_only,
                            repair_data=args.repair_data)
@@ -145,6 +181,22 @@ def main(argv=None) -> int:
     finally:
         if recorder is not None:
             from delphi_tpu import observability as obs
+            if args.baseline_report and status == "ok":
+                # drift gate BEFORE stop_recording: finalize freezes this
+                # run's scorecards, and the drift.* gauges land while the
+                # live /metrics plane is still serving
+                from delphi_tpu.observability import drift, provenance
+                try:
+                    provenance.finalize(recorder)
+                    baseline = obs.load_run_report(args.baseline_report)
+                    drift_result = drift.evaluate(
+                        recorder.scorecards, baseline,
+                        fail_over=args.drift_fail_over,
+                        registry=recorder.registry)
+                    recorder.drift = drift_result
+                except Exception as e:
+                    print(f"drift gate failed to evaluate: {e}",
+                          file=sys.stderr)
             obs.stop_recording(recorder)
             if args.metrics_out:
                 obs.write_run_report(
@@ -156,6 +208,15 @@ def main(argv=None) -> int:
                     args.metrics_out)
     result.to_csv(args.output, index=False)
     print(f"wrote {len(result)} rows to {args.output}", file=sys.stderr)
+    if drift_result is not None:
+        print("drift vs {}: max divergence {} (psi={}, js={})".format(
+            args.baseline_report, drift_result["max_divergence"],
+            drift_result["max_confidence_psi"],
+            drift_result["max_repair_value_js"]), file=sys.stderr)
+        if drift_result.get("failed"):
+            print("drift gate FAILED (fail-over "
+                  f"{args.drift_fail_over})", file=sys.stderr)
+            return 3
     return 0
 
 

@@ -36,7 +36,8 @@ from delphi_tpu.table import (
     EncodedTable, KIND_FRACTIONAL, KIND_INTEGRAL, check_input_table)
 from delphi_tpu.train import (
     build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
-from delphi_tpu.observability import counter_inc, gauge_set
+from delphi_tpu.observability import active_ledger, counter_inc, gauge_set
+from delphi_tpu.observability import provenance as _prov
 from delphi_tpu.utils import (
     argtype_check, elapsed_time, get_option_value, job_phase, log_based_on_level,
     phase_span, profile_trace, setup_logger, to_list_str)
@@ -578,13 +579,25 @@ class RepairModel:
                          error_cells_df: pd.DataFrame, target_columns: List[str],
                          integral_columns: Set[str]) \
             -> Tuple[pd.DataFrame, pd.DataFrame]:
+        led = active_ledger()
+
+        def _record_rule_repairs(frame: pd.DataFrame, reason: str) -> None:
+            if led is not None and len(frame):
+                led.record_decisions(
+                    frame[self._row_id].to_numpy(),
+                    frame["attribute"].to_numpy(dtype=object),
+                    _prov.DECISION_REPAIRED, reason,
+                    repaired=frame["repaired"].to_numpy(dtype=object))
+
         repaired_dfs = [self._empty_repaired_cells_frame()]
         if self._repair_by_regex_enabled:
             error_cells_df, by_regex = self._repair_by_regexs(error_cells_df)
+            _record_rule_repairs(by_regex, _prov.REASON_RULE_REGEX)
             repaired_dfs.append(by_regex)
         if self._repair_by_nearest_values_enabled:
             error_cells_df, by_nv = self._repair_by_nearest_values(
                 masked, error_cells_df, target_columns, integral_columns)
+            _record_rule_repairs(by_nv, _prov.REASON_RULE_NEAREST_VALUE)
             repaired_dfs.append(by_nv)
         repaired_by_rules = pd.concat(repaired_dfs, ignore_index=True)
         return error_cells_df, repaired_by_rules
@@ -1107,12 +1120,15 @@ class RepairModel:
             if pd.api.types.is_integer_dtype(dirty_rows_df[c].dtype)}
         need_pmf = compute_repair_candidate_prob or maximal_likelihood_repair
 
+        led = active_ledger()
         pdf = dirty_rows_df.reset_index(drop=True).copy()
         for y, (model, features, transformers) in models:
             missing = pdf[y].isna()
             miss_idx = np.nonzero(missing.to_numpy())[0]
             if len(miss_idx) == 0:
                 continue
+            miss_rids = pdf[self._row_id].to_numpy()[miss_idx] \
+                if led is not None else None
 
             # Inference only over the rows whose y cell actually needs a
             # repair — the clean cells of the dirty block keep their values.
@@ -1123,6 +1139,11 @@ class RepairModel:
             if need_pmf and y not in continuous_columns:
                 predicted = model.predict_proba(X)
                 classes_str = [str(c) for c in model.classes_.tolist()]
+                if led is not None:
+                    led.record_posterior(y, miss_rids, classes_str,
+                                         np.asarray(predicted,
+                                                    dtype=np.float64),
+                                         domain_size=len(classes_str))
 
                 def _to_pmf(probs: Any) -> Dict[str, Any]:
                     if probs is None:
@@ -1135,6 +1156,26 @@ class RepairModel:
                 pdf[y] = filled
             else:
                 predicted = np.asarray(model.predict(X))
+                if led is not None:
+                    # ledger-only posterior: the plain prediction path never
+                    # calls predict_proba, so the top-k comes from an extra
+                    # launch (an opt-in cost, paid only with the flag set);
+                    # models without predict_proba record a degenerate top-1
+                    try:
+                        if y not in continuous_columns \
+                                and hasattr(model, "predict_proba") \
+                                and hasattr(model, "classes_"):
+                            led.record_posterior(
+                                y, miss_rids,
+                                [str(c) for c in model.classes_.tolist()],
+                                np.asarray(model.predict_proba(X),
+                                           dtype=np.float64),
+                                domain_size=len(model.classes_))
+                        else:
+                            led.record_point_predictions(y, miss_rids,
+                                                         predicted)
+                    except Exception:
+                        led.record_point_predictions(y, miss_rids, predicted)
                 if y in integral_columns:
                     vals = np.round(pd.to_numeric(
                         pd.Series(predicted), errors="coerce").to_numpy())
@@ -1296,6 +1337,22 @@ class RepairModel:
                     return None
             return None
 
+        led = active_ledger()
+
+        def _record_keep_all(cands: List[Any]) -> None:
+            # the distinct "confidence unavailable -> keep all repairs"
+            # fallback: every fixable cell of the affected rows keeps its
+            # model repair, with the sticky reason explaining why no
+            # minimization happened
+            if led is None:
+                return
+            for _i, r, _row_flagged, fixable, _options in cands:
+                rid = table.row_id_values[r]
+                for a in fixable:
+                    led.record_decision(
+                        rid, a, _prov.DECISION_REPAIRED,
+                        _prov.REASON_CONFIDENCE_UNAVAILABLE)
+
         out = repaired_rows_df
         # (frame position, attr) -> the ORIGINAL model repair, recorded the
         # first time any plan reverts that cell (later plans reverting the
@@ -1353,11 +1410,13 @@ class RepairModel:
                 for i, s in zip(row_is, scores):
                     conf[(a, i)] = float(s)
             if not usable:
+                _record_keep_all(candidates)
                 continue
 
             for i, r, row_flagged, fixable, options in candidates:
                 scored = [(conf.get((a, i), np.nan), a) for a in options]
                 if any(np.isnan(s) for s, _ in scored):
+                    _record_keep_all([(i, r, row_flagged, fixable, options)])
                     continue  # confidence unavailable -> keep all repairs
                 best = max(scored)[1]
                 reverted = []
@@ -1367,6 +1426,11 @@ class RepairModel:
                             (i, a), out.at[out.index[i], a])
                         out.at[out.index[i], a] = row_flagged[a]
                         reverted.append(a)
+                if led is not None and reverted:
+                    rid = table.row_id_values[r]
+                    for a in reverted:
+                        led.record_decision(rid, a, _prov.DECISION_KEPT,
+                                            _prov.REASON_DC_MINIMIZED)
                 if reverted:
                     _logger.info(
                         "[Repairing Phase] one-tuple DC on row {}: keeping "
@@ -1405,6 +1469,12 @@ class RepairModel:
                             for a in restorable:
                                 out.at[out.index[i], a] = \
                                     revert_log.pop((i, a))
+                                if led is not None:
+                                    # the revert was undone: drop the
+                                    # provisional dc_minimized_revert so the
+                                    # extraction pass re-derives the outcome
+                                    led.clear_decision(
+                                        table.row_id_values[pos[i]], a)
                             changed = True
                 if not changed:
                     break
@@ -1561,6 +1631,14 @@ class RepairModel:
         pmf_df = pd.DataFrame(
             out, columns=[self._row_id, "attribute", "current_value", "pmf"])
         assert len(pmf_df) == len(error_cells_df)
+        led = active_ledger()
+        if led is not None and len(pmf_df):
+            # overwrite the raw posterior with the cost-weighted top-k the
+            # candidate selection actually ranks on
+            for attr, group in pmf_df.groupby("attribute", sort=False):
+                led.record_pmf_topk(str(attr),
+                                    group[self._row_id].tolist(),
+                                    group["pmf"].tolist())
         return pmf_df
 
     def _finish_candidate_prob(self, pmf_df: pd.DataFrame,
@@ -1624,7 +1702,22 @@ class RepairModel:
         percent = min(1.0, 1.0 - self.repair_delta / num_error_cells)
         thres = float(np.percentile(score_df["score"].to_numpy(), percent * 100.0)) \
             if len(score_df) else 0.0
-        top = score_df[score_df["score"] >= thres].drop(columns=["score"])
+        selected = score_df["score"].to_numpy() >= thres
+        top = score_df[selected].drop(columns=["score"])
+        led = active_ledger()
+        if led is not None and len(score_df):
+            rids = score_df[self._row_id].to_numpy()
+            attrs = score_df["attribute"].to_numpy(dtype=object)
+            reps = score_df["repaired"].to_numpy(dtype=object)
+            if selected.any():
+                led.record_decisions(rids[selected], attrs[selected],
+                                     _prov.DECISION_REPAIRED,
+                                     _prov.REASON_MAXIMAL_LIKELIHOOD,
+                                     repaired=reps[selected])
+            if (~selected).any():
+                led.record_decisions(rids[~selected], attrs[~selected],
+                                     _prov.DECISION_BELOW_THRESHOLD,
+                                     _prov.REASON_BELOW_SCORE_THRESHOLD)
         _logger.info(
             "[Repairing Phase] {} repair updates (delta={}) selected among {} "
             "candidates".format(len(top), self.repair_delta, num_error_cells))
@@ -1698,6 +1791,13 @@ class RepairModel:
         keep = np.array([k not in violating for k in keys])
         dropped = int((~keep).sum())
         if dropped:
+            led = active_ledger()
+            if led is not None:
+                dropped_df = repair_candidates[~keep]
+                led.record_decisions(
+                    dropped_df[self._row_id].to_numpy(),
+                    dropped_df["attribute"].to_numpy(dtype=object),
+                    _prov.DECISION_KEPT, _prov.REASON_VALIDATION_VIOLATION)
             _logger.info(
                 f"[Validation Phase] Dropped {dropped}/{len(keys)} repairs "
                 "that still violate integrity constraints")
@@ -2150,6 +2250,27 @@ class RepairModel:
             (_is_null(r) or not _null_safe_eq(c, r)
              for c, r in zip(curs_np, repaired)),
             dtype=bool, count=len(cells_rows))
+        led = active_ledger()
+        if led is not None and len(cells_rows):
+            # sticky-aware: a reason a more specific pass recorded (DC
+            # minimization, rules, the confidence fallback) survives; the
+            # generic outcome below fills in everything else
+            rep_null = np.fromiter((_is_null(r) for r in repaired),
+                                   dtype=bool, count=len(repaired))
+            for mask, decision, reason, rep in (
+                    (keep & ~rep_null, _prov.DECISION_REPAIRED,
+                     _prov.REASON_MODEL_REPAIR, repaired),
+                    (keep & rep_null, _prov.DECISION_KEPT,
+                     _prov.REASON_NO_PREDICTION, None),
+                    (valid & ~keep, _prov.DECISION_KEPT,
+                     _prov.REASON_PREDICTION_MATCHES_CURRENT, None),
+                    (~valid, _prov.DECISION_KEPT,
+                     _prov.REASON_NOT_TARGETED, None)):
+                if mask.any():
+                    led.record_decisions(
+                        rid_np[mask], attrs_np[mask], decision, reason,
+                        repaired=rep[mask] if rep is not None else None,
+                        sticky_aware=True)
         if not keep.any():
             return empty
         ranks = np.fromiter((col_rank.get(a, 0) for a in attrs_np),
@@ -2198,12 +2319,17 @@ class RepairModel:
         ``repair.metrics.port``) additionally serves live telemetry —
         ``/metrics``, ``/healthz``, ``/report`` — plus a stall watchdog and
         resource sampler for the run's duration, with or without a report
-        path (see delphi_tpu/observability)."""
+        path (see delphi_tpu/observability). ``DELPHI_PROVENANCE_PATH`` (or
+        ``repair.provenance.path``) records a per-cell repair provenance
+        ledger — detector, domain size, top-k posterior, final decision —
+        written as JSONL when the run finishes (``:memory:`` keeps it
+        in-process) and aggregated into per-attribute quality scorecards in
+        the run report."""
         from delphi_tpu import observability as obs
 
         report_path = obs.metrics_path()
         recorder = None
-        if report_path or obs.live_configured():
+        if report_path or obs.live_configured() or obs.provenance_configured():
             recorder = obs.start_recording(
                 "repair.run", events_path=obs.events_path_for(report_path))
 

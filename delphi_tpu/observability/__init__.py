@@ -16,6 +16,17 @@ Four pieces (see docs/source/observability.rst):
   server (``/metrics`` Prometheus text, ``/healthz``, ``/report``) enabled
   via ``DELPHI_METRICS_PORT`` / ``repair.metrics.port``, a stall watchdog,
   and a periodic resource sampler.
+* :mod:`~delphi_tpu.observability.provenance` — per-cell repair provenance
+  ledger (``DELPHI_PROVENANCE_PATH`` / ``repair.provenance.path``) recording
+  detector, candidate-domain size, top-k posterior, and final decision for
+  every flagged cell, aggregated into per-attribute quality scorecards in
+  the run report (schema v3).
+* :mod:`~delphi_tpu.observability.drift` — cross-run drift gate comparing
+  the current scorecards against a baseline run report (PSI / JS divergence)
+  and emitting ``drift.*`` gauges; wired by ``main.py --baseline-report``.
+* :mod:`~delphi_tpu.observability.diff` — the ``report-diff`` CLI
+  (``python -m delphi_tpu.observability.diff``) printing metric, phase-time,
+  and scorecard deltas between two run-report files.
 """
 
 import os
@@ -23,6 +34,10 @@ from typing import Optional
 
 from delphi_tpu.observability.live import (  # noqa: F401
     LivePlane, live_configured, metrics_port,
+)
+from delphi_tpu.observability.provenance import (  # noqa: F401
+    ProvenanceLedger, active_ledger, merge_scorecards, provenance_configured,
+    provenance_path, scorecard_summary,
 )
 from delphi_tpu.observability.registry import (  # noqa: F401
     MetricsRegistry, counter_inc, gauge_max, gauge_set, histogram_observe,

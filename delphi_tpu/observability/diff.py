@@ -1,0 +1,148 @@
+"""report-diff: metric/scorecard deltas between two run-report files.
+
+    python -m delphi_tpu.observability.diff BASELINE.json CURRENT.json
+
+Prints counter/gauge deltas (largest relative change first), per-phase
+wall-time deltas, and — for schema-v3 reports carrying provenance
+scorecards — per-attribute repair-quality deltas plus the same PSI/JS
+divergences the drift gate (``observability/drift.py``) computes. The
+manual companion to ``main.py --baseline-report``: same math, human-readable
+output, no gating.
+"""
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _metric_maps(report: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+    metrics = report.get("metrics") or {}
+    return {"counters": dict(metrics.get("counters") or {}),
+            "gauges": dict(metrics.get("gauges") or {})}
+
+
+def _span_walls(span: Optional[Dict[str, Any]],
+                out: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    if out is None:
+        out = {}
+    if span:
+        out[span.get("name", "?")] = out.get(span.get("name", "?"), 0.0) \
+            + float(span.get("wall_s", 0.0))
+        for child in span.get("children", []):
+            _span_walls(child, out)
+    return out
+
+
+def build_report_diff(baseline: Dict[str, Any],
+                      current: Dict[str, Any]) -> Dict[str, Any]:
+    """Structured delta between two (upgraded) run reports."""
+    from delphi_tpu.observability.drift import compare_scorecards
+
+    diff: Dict[str, Any] = {"metrics": {}, "spans": {}, "scorecards": None}
+    base_m, cur_m = _metric_maps(baseline), _metric_maps(current)
+    for kind in ("counters", "gauges"):
+        deltas = {}
+        for name in sorted(set(base_m[kind]) | set(cur_m[kind])):
+            b, c = base_m[kind].get(name), cur_m[kind].get(name)
+            if b == c:
+                continue
+            deltas[name] = {
+                "baseline": b, "current": c,
+                "delta": None if b is None or c is None
+                else round(float(c) - float(b), 6)}
+        diff["metrics"][kind] = deltas
+
+    base_w = _span_walls(baseline.get("spans"))
+    cur_w = _span_walls(current.get("spans"))
+    for name in sorted(set(base_w) | set(cur_w)):
+        b, c = base_w.get(name), cur_w.get(name)
+        if b is None or c is None or abs(c - b) > 1e-6:
+            diff["spans"][name] = {
+                "baseline_s": None if b is None else round(b, 3),
+                "current_s": None if c is None else round(c, 3),
+                "delta_s": None if b is None or c is None
+                else round(c - b, 3)}
+
+    base_cards = baseline.get("scorecards")
+    cur_cards = current.get("scorecards")
+    if base_cards or cur_cards:
+        diff["scorecards"] = compare_scorecards(cur_cards or {},
+                                                base_cards or {})
+    return diff
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def format_report_diff(diff: Dict[str, Any], top: int = 25) -> str:
+    lines: List[str] = []
+    for kind in ("counters", "gauges"):
+        deltas = diff["metrics"].get(kind) or {}
+        if not deltas:
+            continue
+        lines.append(f"{kind} ({len(deltas)} changed):")
+        ranked = sorted(
+            deltas.items(),
+            key=lambda kv: -abs(kv[1]["delta"] or float("inf"))
+            if kv[1]["delta"] is not None else float("-inf"))
+        for name, d in ranked[:top]:
+            lines.append(f"  {name}: {_fmt(d['baseline'])} -> "
+                         f"{_fmt(d['current'])} ({_fmt(d['delta'])})")
+        if len(ranked) > top:
+            lines.append(f"  ... and {len(ranked) - top} more")
+    if diff["spans"]:
+        lines.append("phase wall time (s):")
+        for name, d in sorted(diff["spans"].items(),
+                              key=lambda kv: -(abs(kv[1]["delta_s"] or 0.0))):
+            lines.append(f"  {name}: {_fmt(d['baseline_s'])} -> "
+                         f"{_fmt(d['current_s'])} ({_fmt(d['delta_s'])})")
+    cards = diff.get("scorecards")
+    if cards:
+        lines.append("scorecard drift (baseline -> current):")
+        for attr, d in sorted(cards["per_attribute"].items()):
+            if "confidence_psi" not in d:
+                lines.append(f"  {attr}: {d['status']}")
+                continue
+            lines.append(
+                f"  {attr}: confidence_psi={_fmt(d['confidence_psi'])} "
+                f"repair_value_js={_fmt(d['repair_value_js'])} "
+                f"repair_rate_delta={_fmt(d['repair_rate_delta'])} "
+                f"cells_flagged_delta={_fmt(d['cells_flagged_delta'])}")
+        lines.append(f"  max divergence: {_fmt(cards['max_divergence'])} "
+                     f"(psi={_fmt(cards['max_confidence_psi'])}, "
+                     f"js={_fmt(cards['max_repair_value_js'])})")
+    if not lines:
+        lines.append("reports are metrically identical")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m delphi_tpu.observability.diff",
+        description="print metric/scorecard deltas between two run reports")
+    parser.add_argument("baseline", help="baseline run-report JSON path")
+    parser.add_argument("current", help="current run-report JSON path")
+    parser.add_argument("--top", type=int, default=25,
+                        help="max changed metrics to print per section")
+    args = parser.parse_args(argv)
+
+    from delphi_tpu.observability.report import load_run_report
+
+    baseline = load_run_report(args.baseline)
+    current = load_run_report(args.current)
+    if baseline is None or current is None:
+        missing = args.baseline if baseline is None else args.current
+        print(f"cannot load run report: {missing}", file=sys.stderr)
+        return 2
+    print(format_report_diff(build_report_diff(baseline, current),
+                             top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

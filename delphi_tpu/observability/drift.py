@@ -1,0 +1,168 @@
+"""Cross-run drift gate over per-attribute quality scorecards.
+
+Compares the current run's scorecards (``observability/provenance.py``)
+against a baseline run report and quantifies how differently the repair
+pipeline behaved:
+
+* **PSI** (population stability index) on each attribute's confidence
+  histogram — did the model get more/less sure of its repairs?
+* **Jensen–Shannon divergence** (base 2, so in [0, 1]) on each attribute's
+  repaired-value distribution — is it writing different values?
+* repair-rate delta per attribute.
+
+``main.py --baseline-report`` wires this up for CI-style regression gating:
+the per-attribute and max divergences land as ``drift.*`` gauges in the
+active metrics registry (so the live ``/metrics`` plane exposes them while
+the server is still up) and in the run report's ``drift`` section, and
+``--drift-fail-over X`` fails the run when the max divergence exceeds X.
+
+Rule of thumb (the PSI folklore thresholds): < 0.1 no meaningful change,
+0.1–0.25 moderate shift worth a look, > 0.25 the runs behave differently.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_EPS = 1e-6
+
+
+def _normalize(counts: Sequence[float]) -> Optional[List[float]]:
+    total = float(sum(counts))
+    if total <= 0:
+        return None
+    return [c / total for c in counts]
+
+
+def population_stability_index(current: Sequence[float],
+                               baseline: Sequence[float]) -> float:
+    """PSI over two aligned count vectors; zero-padded bins are smoothed
+    with a small epsilon so empty bins don't blow up the log ratio. Two
+    empty distributions (e.g. an attribute with no confident repairs in
+    either run) diverge by 0."""
+    p = _normalize(current)
+    q = _normalize(baseline)
+    if p is None or q is None:
+        return 0.0
+    psi = 0.0
+    for pi, qi in zip(p, q):
+        pi = max(pi, _EPS)
+        qi = max(qi, _EPS)
+        psi += (pi - qi) * math.log(pi / qi)
+    return psi
+
+
+def jensen_shannon_divergence(current: Sequence[float],
+                              baseline: Sequence[float]) -> float:
+    """Base-2 JS divergence over two aligned count vectors, in [0, 1]."""
+    p = _normalize(current)
+    q = _normalize(baseline)
+    if p is None or q is None:
+        return 0.0
+    js = 0.0
+    for pi, qi in zip(p, q):
+        mi = 0.5 * (pi + qi)
+        if pi > 0:
+            js += 0.5 * pi * math.log2(pi / mi)
+        if qi > 0:
+            js += 0.5 * qi * math.log2(qi / mi)
+    return max(js, 0.0)
+
+
+def _aligned_value_counts(cur: Dict[str, int], base: Dict[str, int]) \
+        -> Tuple[List[float], List[float]]:
+    keys = sorted(set(cur) | set(base))
+    return ([float(cur.get(k, 0)) for k in keys],
+            [float(base.get(k, 0)) for k in keys])
+
+
+def compare_scorecards(current: Dict[str, Any],
+                       baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-attribute drift between two scorecard maps. Attributes present
+    on only one side are reported but excluded from the max divergences
+    (a new/removed column is a schema change, not distribution drift)."""
+    per_attr: Dict[str, Any] = {}
+    for attr in sorted(set(current) | set(baseline)):
+        c, b = current.get(attr), baseline.get(attr)
+        if c is None or b is None:
+            per_attr[attr] = {
+                "status": "missing_in_current" if c is None
+                else "missing_in_baseline"}
+            continue
+        conf_psi = population_stability_index(
+            c.get("confidence", {}).get("bins", []),
+            b.get("confidence", {}).get("bins", []))
+        cur_rv, base_rv = _aligned_value_counts(
+            c.get("repaired_values", {}), b.get("repaired_values", {}))
+        rv_js = jensen_shannon_divergence(cur_rv, base_rv)
+        per_attr[attr] = {
+            "confidence_psi": round(conf_psi, 6),
+            "repair_value_js": round(rv_js, 6),
+            "repair_rate_delta": round(
+                c.get("repair_rate", 0.0) - b.get("repair_rate", 0.0), 6),
+            "cells_flagged_delta":
+                c.get("cells_flagged", 0) - b.get("cells_flagged", 0),
+        }
+    scored = [v for v in per_attr.values() if "confidence_psi" in v]
+    max_psi = max((v["confidence_psi"] for v in scored), default=0.0)
+    max_js = max((v["repair_value_js"] for v in scored), default=0.0)
+    return {
+        "per_attribute": per_attr,
+        "max_confidence_psi": round(max_psi, 6),
+        "max_repair_value_js": round(max_js, 6),
+        "max_divergence": round(max(max_psi, max_js), 6),
+    }
+
+
+def emit_drift_gauges(registry: Any, drift: Dict[str, Any]) -> None:
+    """Lands the drift result as ``drift.*`` gauges; while the live plane is
+    up they render on ``/metrics`` like every other registry gauge."""
+    for attr, v in drift.get("per_attribute", {}).items():
+        if "confidence_psi" not in v:
+            continue
+        registry.set_gauge(f"drift.{attr}.confidence_psi",
+                           v["confidence_psi"])
+        registry.set_gauge(f"drift.{attr}.repair_value_js",
+                           v["repair_value_js"])
+        registry.set_gauge(f"drift.{attr}.repair_rate_delta",
+                           v["repair_rate_delta"])
+    registry.set_gauge("drift.max_confidence_psi",
+                       drift.get("max_confidence_psi", 0.0))
+    registry.set_gauge("drift.max_repair_value_js",
+                       drift.get("max_repair_value_js", 0.0))
+    registry.set_gauge("drift.max_divergence",
+                       drift.get("max_divergence", 0.0))
+    if drift.get("failed") is not None:
+        registry.set_gauge("drift.failed", 1.0 if drift["failed"] else 0.0)
+
+
+def evaluate(current_scorecards: Optional[Dict[str, Any]],
+             baseline_report: Optional[Dict[str, Any]],
+             fail_over: Optional[float] = None,
+             registry: Any = None) -> Dict[str, Any]:
+    """The full drift gate: compare, attach the fail verdict, emit gauges.
+
+    ``baseline_report`` is a loaded run report (v1/v2 reports upgrade but
+    carry no scorecards — the result then flags ``baseline_missing`` and
+    never fails the gate, so a freshly-introduced baseline can't block CI).
+    """
+    baseline_cards = (baseline_report or {}).get("scorecards") or {}
+    result = compare_scorecards(current_scorecards or {}, baseline_cards)
+    result["baseline_missing"] = not baseline_cards
+    result["fail_over"] = fail_over
+    result["failed"] = bool(
+        fail_over is not None and baseline_cards
+        and result["max_divergence"] > fail_over)
+    if registry is not None:
+        try:
+            emit_drift_gauges(registry, result)
+        except Exception as e:
+            _logger.warning(f"failed to emit drift gauges: {e}")
+    if result["failed"]:
+        _logger.warning(
+            "drift gate FAILED: max divergence {} > fail-over {}".format(
+                result["max_divergence"], fail_over))
+    return result

@@ -1,0 +1,537 @@
+"""Per-cell repair provenance ledger + per-attribute quality scorecards.
+
+Answers "why did this cell change?" without rerunning anything: when
+``DELPHI_PROVENANCE_PATH`` (or the ``repair.provenance.path`` session
+config) is set, every flagged cell accumulates one ledger entry across the
+pipeline phases —
+
+* the detector(s) that flagged it (``errors.py`` / ``ops/detect.py``,
+  including the per-constraint label for denial constraints),
+* the candidate domain size the naive-Bayes scoring considered
+  (``ops/domain.py``),
+* the model's top-k posterior with probabilities (the ``prob_top_k`` PMF
+  path and the plain prediction path both hook in),
+* the final decision (``repaired`` / ``kept`` / ``below_threshold``) and a
+  ``decision_reason`` — including the one-tuple-DC minimization's
+  "confidence unavailable -> keep all repairs" fallback, recorded as the
+  distinct :data:`REASON_CONFIDENCE_UNAVAILABLE`.
+
+The ledger follows the metrics-registry contract: instrumentation sites
+read one module-level pointer (:func:`active_ledger`) and skip entirely
+when it is ``None`` — a disabled run pays a single pointer check per hook.
+The ledger attaches to the :class:`~delphi_tpu.observability.spans.RunRecorder`
+at ``start_recording`` and finalizes at ``stop_recording``: the JSONL file
+is written (unless the path is ``:memory:``) and the entries aggregate into
+per-attribute **quality scorecards** (repair rate, confidence histogram,
+low-confidence fraction, domain-size distribution, repaired-value counts)
+that embed in the run report as schema v3 and merge across hosts through
+``gather_per_process``. ``observability/drift.py`` compares scorecards
+across runs.
+"""
+
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+MEMORY_PATH = ":memory:"
+
+DECISION_REPAIRED = "repaired"
+DECISION_KEPT = "kept"
+DECISION_BELOW_THRESHOLD = "below_threshold"
+
+REASON_MODEL_REPAIR = "model_repair"
+REASON_MAXIMAL_LIKELIHOOD = "maximal_likelihood"
+REASON_RULE_REGEX = "rule_regex"
+REASON_RULE_NEAREST_VALUE = "rule_nearest_value"
+REASON_PREDICTION_MATCHES_CURRENT = "prediction_matches_current"
+REASON_WEAK_LABEL_CLEAN = "weak_label_clean"
+REASON_NOT_TARGETED = "attribute_not_targeted"
+REASON_NO_PREDICTION = "no_prediction"
+REASON_DC_MINIMIZED = "dc_minimized_revert"
+REASON_CONFIDENCE_UNAVAILABLE = "confidence_unavailable_keep_all"
+REASON_VALIDATION_VIOLATION = "validation_violation"
+REASON_BELOW_SCORE_THRESHOLD = "below_score_threshold"
+REASON_NO_REPAIR_ATTEMPTED = "no_repair_attempted"
+
+# Reasons a later, more generic decision pass (candidate extraction) must
+# not overwrite: they carry WHY the generic outcome happened.
+_STICKY_REASONS = frozenset({
+    REASON_DC_MINIMIZED, REASON_CONFIDENCE_UNAVAILABLE,
+    REASON_RULE_REGEX, REASON_RULE_NEAREST_VALUE,
+})
+
+CONFIDENCE_BINS = 20
+LOW_CONFIDENCE = 0.5  # top-posterior threshold for "low confidence" repairs
+_VALUE_CAP = 50       # distinct repaired values kept per attribute scorecard
+OTHER_VALUES = "__other__"
+
+
+def provenance_path() -> Optional[str]:
+    """The configured ledger destination (``:memory:`` keeps it in-process
+    only), or ``None`` when provenance is disabled. ``DELPHI_PROVENANCE_PATH``
+    wins over the ``repair.provenance.path`` session config — the same
+    precedence as every other observability toggle."""
+    path = os.environ.get("DELPHI_PROVENANCE_PATH")
+    if path:
+        return path
+    from delphi_tpu.session import get_session
+
+    return get_session().conf.get("repair.provenance.path") or None
+
+
+def provenance_configured() -> bool:
+    return provenance_path() is not None
+
+
+def _top_k() -> int:
+    """Posterior entries kept per cell (``DELPHI_PROVENANCE_TOP_K``)."""
+    try:
+        return max(1, int(os.environ.get("DELPHI_PROVENANCE_TOP_K", "5")))
+    except ValueError:
+        return 5
+
+
+def _is_null(v: Any) -> bool:
+    if v is None:
+        return True
+    try:
+        import math
+
+        return isinstance(v, float) and math.isnan(v)
+    except Exception:
+        return False
+
+
+def _spell(v: Any) -> Optional[str]:
+    return None if _is_null(v) else str(v)
+
+
+class ProvenanceLedger:
+    """Accumulates one record per flagged cell, keyed by
+    ``(str(row_id), attribute)``. Hooks are vectorized — one call per
+    detector frame / attribute chunk, not per cell — and thread-safe (the
+    batched trainer and the live ``/report`` endpoint may touch it off the
+    main thread)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.top_k = _top_k()
+        self.model_scores: Dict[str, float] = {}
+        self._cells: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # row position -> row id spelling, filled during detection (phase 1
+        # frames carry both); lets position-keyed phases (domain scoring)
+        # land on the same entries as id-keyed phases (repair decisions).
+        self._rid_of: Dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._written = False
+
+    def __len__(self) -> int:
+        return len(self._cells)
+
+    def _entry(self, rid: str, attr: str) -> Dict[str, Any]:
+        key = (rid, attr)
+        e = self._cells.get(key)
+        if e is None:
+            e = self._cells[key] = {"row_id": rid, "attribute": attr,
+                                    "detectors": []}
+        return e
+
+    # -- phase 1: detection ------------------------------------------------
+
+    def record_detection(self, detector: str, rows: Sequence[int],
+                         attrs: Any, row_ids: Sequence[Any]) -> None:
+        """One call per detector result frame. ``attrs`` is either an array
+        aligned with ``rows`` or a single attribute name."""
+        scalar_attr = isinstance(attrs, str)
+        with self._lock:
+            for i, rid in enumerate(row_ids):
+                rid_s = str(rid)
+                attr = attrs if scalar_attr else str(attrs[i])
+                self._rid_of[int(rows[i])] = rid_s
+                e = self._entry(rid_s, attr)
+                if detector not in e["detectors"]:
+                    e["detectors"].append(detector)
+
+    def record_current_values(self, row_ids: Sequence[Any], attrs: Sequence[Any],
+                              currents: Sequence[Any]) -> None:
+        with self._lock:
+            for rid, a, c in zip(row_ids, attrs, currents):
+                self._entry(str(rid), str(a))["current_value"] = _spell(c)
+
+    # -- phase 1b: domain analysis ----------------------------------------
+
+    def record_domain_sizes(self, rows: Sequence[int], attr: str,
+                            sizes: Sequence[int]) -> None:
+        """Candidate domain size per cell, keyed by row POSITION (domain
+        scoring never sees row ids; detection filled the translation)."""
+        with self._lock:
+            a = str(attr)
+            for r, s in zip(rows, sizes):
+                rid = self._rid_of.get(int(r))
+                if rid is not None:
+                    self._entry(rid, a)["domain_size"] = int(s)
+
+    def record_weak_label_demotions(self, row_ids: Sequence[Any],
+                                    attrs: Sequence[Any]) -> None:
+        with self._lock:
+            for rid, a in zip(row_ids, attrs):
+                e = self._entry(str(rid), str(a))
+                e["decision"] = DECISION_KEPT
+                e["decision_reason"] = REASON_WEAK_LABEL_CLEAN
+
+    # -- phase 2: training -------------------------------------------------
+
+    def record_model_score(self, attr: str, score: Any) -> None:
+        try:
+            s = float(score)
+        except (TypeError, ValueError):
+            return
+        if s == s and s not in (float("inf"), float("-inf")):
+            with self._lock:
+                self.model_scores[str(attr)] = s
+
+    # -- phase 3: repair ---------------------------------------------------
+
+    def record_posterior(self, attr: str, row_ids: Sequence[Any],
+                         classes: Sequence[str], probs: Any,
+                         domain_size: Optional[int] = None) -> None:
+        """Top-k posterior per cell from one ``predict_proba`` launch:
+        ``probs`` is an (n, k) matrix aligned with ``row_ids``; ``classes``
+        the shared class list. ``domain_size`` (the model's class count)
+        fills in where domain scoring didn't run for the cell or kept no
+        candidates (the model then considered its full class list)."""
+        import numpy as np
+
+        P = np.asarray(probs, dtype=np.float64)
+        if P.ndim != 2 or len(P) != len(row_ids):
+            return
+        kk = min(self.top_k, P.shape[1])
+        order = np.argsort(-P, axis=1, kind="stable")[:, :kk]
+        top = np.take_along_axis(P, order, axis=1)
+        a = str(attr)
+        with self._lock:
+            for i, rid in enumerate(row_ids):
+                e = self._entry(str(rid), a)
+                e["top_k"] = [{"value": str(classes[j]),
+                               "prob": round(float(p), 6)}
+                              for j, p in zip(order[i], top[i])]
+                e["confidence"] = float(top[i, 0]) if kk else None
+                if domain_size is not None and not e.get("domain_size"):
+                    e["domain_size"] = int(domain_size)
+
+    def record_point_predictions(self, attr: str, row_ids: Sequence[Any],
+                                 values: Sequence[Any],
+                                 domain_size: Optional[int] = None) -> None:
+        """Degenerate posterior for models without ``predict_proba``
+        (regressors, FD rules, constant fallbacks): top-1, no probability."""
+        a = str(attr)
+        with self._lock:
+            for rid, v in zip(row_ids, values):
+                e = self._entry(str(rid), a)
+                e["top_k"] = [{"value": _spell(v), "prob": None}]
+                if domain_size is not None and not e.get("domain_size"):
+                    e["domain_size"] = int(domain_size)
+
+    def record_pmf_topk(self, attr: str, row_ids: Sequence[Any],
+                        pmf_lists: Iterable[List[Dict[str, Any]]]) -> None:
+        """Cost-weighted top-k from the ``prob_top_k`` PMF path — overwrites
+        the raw posterior with what the candidate selection actually used."""
+        a = str(attr)
+        with self._lock:
+            for rid, pmf in zip(row_ids, pmf_lists):
+                if not pmf:
+                    continue
+                e = self._entry(str(rid), a)
+                e["top_k"] = [{"value": _spell(p.get("class")),
+                               "prob": round(float(p.get("prob", 0.0)), 6)}
+                              for p in pmf[:self.top_k]]
+                e["confidence"] = float(pmf[0].get("prob", 0.0))
+
+    def record_decisions(self, row_ids: Sequence[Any], attrs: Any,
+                         decision: str, reason: str,
+                         repaired: Optional[Sequence[Any]] = None,
+                         sticky_aware: bool = False) -> None:
+        """Final (or provisional) decision for many cells. With
+        ``sticky_aware`` the decision/repaired value still update, but a
+        reason in :data:`_STICKY_REASONS` recorded by an earlier, more
+        specific pass is preserved."""
+        scalar_attr = isinstance(attrs, str)
+        with self._lock:
+            for i, rid in enumerate(row_ids):
+                attr = attrs if scalar_attr else str(attrs[i])
+                e = self._entry(str(rid), attr)
+                e["decision"] = decision
+                if not (sticky_aware
+                        and e.get("decision_reason") in _STICKY_REASONS):
+                    e["decision_reason"] = reason
+                if repaired is not None:
+                    e["repaired"] = _spell(repaired[i])
+
+    def record_decision(self, row_id: Any, attr: str, decision: str,
+                        reason: str, repaired: Any = None) -> None:
+        with self._lock:
+            e = self._entry(str(row_id), str(attr))
+            e["decision"] = decision
+            e["decision_reason"] = reason
+            if repaired is not None:
+                e["repaired"] = _spell(repaired)
+
+    def clear_decision(self, row_id: Any, attr: str) -> None:
+        """Undo a provisional decision (the DC fixpoint pass restoring a
+        reverted repair) so the extraction pass re-derives it."""
+        with self._lock:
+            e = self._cells.get((str(row_id), str(attr)))
+            if e is not None:
+                e.pop("decision", None)
+                e.pop("decision_reason", None)
+
+    # -- finalize ----------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Ledger rows in insertion order, defaults filled: every entry has
+        a decision/decision_reason (cells no phase decided on — e.g. a
+        detect-only run — report ``kept``/``no_repair_attempted``)."""
+        with self._lock:
+            rows = [dict(e) for e in self._cells.values()]
+        for e in rows:
+            e.setdefault("decision", DECISION_KEPT)
+            e.setdefault("decision_reason", REASON_NO_REPAIR_ATTEMPTED)
+        return rows
+
+    def write(self) -> None:
+        """One-shot atomic JSONL dump (tmp + ``os.replace``); ``:memory:``
+        skips the file entirely."""
+        if self.path == MEMORY_PATH or self._written:
+            return
+        self._written = True
+        try:
+            directory = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(prefix=".provenance_", dir=directory)
+            try:
+                with os.fdopen(fd, "w") as f:
+                    for e in self.entries():
+                        f.write(json.dumps(e, default=str) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            _logger.info(f"Provenance ledger written to {self.path} "
+                         f"({len(self._cells)} cells)")
+        except Exception as e:
+            _logger.warning(f"failed to write provenance ledger: {e}")
+
+    def scorecards(self) -> Dict[str, Dict[str, Any]]:
+        return build_scorecards(self.entries(), self.model_scores)
+
+
+# -- scorecards ------------------------------------------------------------
+
+
+def _empty_card() -> Dict[str, Any]:
+    return {
+        "cells_flagged": 0,
+        "cells_repaired": 0,
+        "detectors": {},
+        "decisions": {},
+        "confidence": {"count": 0, "sum": 0.0, "min": None, "max": None,
+                       "bins": [0] * CONFIDENCE_BINS},
+        "domain_size": {"count": 0, "sum": 0, "min": None, "max": None,
+                        "hist": {}},
+        "repaired_values": {},
+    }
+
+
+def _size_bucket(size: int) -> str:
+    """Power-of-two domain-size buckets: "0", "1", "2-3", "4-7", ..."""
+    if size <= 0:
+        return "0"
+    lo = 1 << (int(size).bit_length() - 1)
+    hi = lo * 2 - 1
+    return str(lo) if hi == lo else f"{lo}-{hi}"
+
+
+def _observe(stats: Dict[str, Any], value: float) -> None:
+    stats["count"] += 1
+    stats["sum"] += value
+    stats["min"] = value if stats["min"] is None else min(stats["min"], value)
+    stats["max"] = value if stats["max"] is None else max(stats["max"], value)
+
+
+def build_scorecards(entries: Iterable[Dict[str, Any]],
+                     model_scores: Optional[Dict[str, float]] = None) \
+        -> Dict[str, Dict[str, Any]]:
+    """Aggregates ledger entries into per-attribute quality scorecards.
+    Every non-derived field merges exactly across hosts (sums, mins/maxes,
+    histogram-bin sums) — see :func:`merge_scorecards`."""
+    cards: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        card = cards.setdefault(e["attribute"], _empty_card())
+        card["cells_flagged"] += 1
+        for d in e.get("detectors") or ["unknown"]:
+            card["detectors"][d] = card["detectors"].get(d, 0) + 1
+        reason = e.get("decision_reason") or REASON_NO_REPAIR_ATTEMPTED
+        card["decisions"][reason] = card["decisions"].get(reason, 0) + 1
+        if e.get("decision") == DECISION_REPAIRED:
+            card["cells_repaired"] += 1
+            v = _spell(e.get("repaired"))
+            if v is not None:
+                rv = card["repaired_values"]
+                rv[v] = rv.get(v, 0) + 1
+        conf = e.get("confidence")
+        if conf is not None and conf == conf:
+            c = min(max(float(conf), 0.0), 1.0)
+            _observe(card["confidence"], c)
+            bins = card["confidence"]["bins"]
+            bins[min(int(c * CONFIDENCE_BINS), CONFIDENCE_BINS - 1)] += 1
+        ds = e.get("domain_size")
+        if ds is not None:
+            _observe(card["domain_size"], int(ds))
+            hist = card["domain_size"]["hist"]
+            b = _size_bucket(int(ds))
+            hist[b] = hist.get(b, 0) + 1
+    for attr, card in cards.items():
+        if model_scores and attr in model_scores:
+            card["model_cv_score"] = round(model_scores[attr], 6)
+        _cap_values(card)
+        _derive(card)
+    return cards
+
+
+def _cap_values(card: Dict[str, Any]) -> None:
+    rv = card["repaired_values"]
+    if len(rv) <= _VALUE_CAP:
+        return
+    top = sorted(rv.items(), key=lambda kv: (-kv[1], kv[0]))
+    kept = dict(top[:_VALUE_CAP])
+    kept[OTHER_VALUES] = kept.get(OTHER_VALUES, 0) \
+        + sum(n for _, n in top[_VALUE_CAP:])
+    card["repaired_values"] = kept
+
+
+def _derive(card: Dict[str, Any]) -> None:
+    """(Re)computes the derived fields from the mergeable raw ones."""
+    flagged = card["cells_flagged"]
+    card["repair_rate"] = round(card["cells_repaired"] / flagged, 6) \
+        if flagged else 0.0
+    conf = card["confidence"]
+    n = conf["count"]
+    conf["mean"] = round(conf["sum"] / n, 6) if n else None
+    low_bins = int(LOW_CONFIDENCE * CONFIDENCE_BINS)
+    conf["low_confidence_fraction"] = \
+        round(sum(conf["bins"][:low_bins]) / n, 6) if n else None
+    ds = card["domain_size"]
+    ds["mean"] = round(ds["sum"] / ds["count"], 6) if ds["count"] else None
+
+
+def merge_scorecards(cards_list: Sequence[Optional[Dict[str, Any]]]) \
+        -> Dict[str, Dict[str, Any]]:
+    """Cluster-wide scorecard merge: counters sum, mins/maxes combine,
+    histogram bins add, derived fields recompute from the merged raws."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for cards in cards_list:
+        for attr, card in (cards or {}).items():
+            m = merged.setdefault(attr, _empty_card())
+            m["cells_flagged"] += card.get("cells_flagged", 0)
+            m["cells_repaired"] += card.get("cells_repaired", 0)
+            for field in ("detectors", "decisions", "repaired_values"):
+                for k, v in card.get(field, {}).items():
+                    m[field][k] = m[field].get(k, 0) + v
+            for field in ("confidence", "domain_size"):
+                src, dst = card.get(field, {}), m[field]
+                dst["count"] += src.get("count", 0)
+                dst["sum"] += src.get("sum", 0)
+                for agg, op in (("min", min), ("max", max)):
+                    v = src.get(agg)
+                    if v is not None:
+                        dst[agg] = v if dst[agg] is None else op(dst[agg], v)
+            for i, v in enumerate(card.get("confidence", {}).get("bins", [])):
+                if i < CONFIDENCE_BINS:
+                    m["confidence"]["bins"][i] += v
+            for b, v in card.get("domain_size", {}).get("hist", {}).items():
+                m["domain_size"]["hist"][b] = \
+                    m["domain_size"]["hist"].get(b, 0) + v
+            if "model_cv_score" in card and "model_cv_score" not in m:
+                m["model_cv_score"] = card["model_cv_score"]
+    for card in merged.values():
+        _cap_values(card)
+        _derive(card)
+    return merged
+
+
+def scorecard_summary(scorecards: Optional[Dict[str, Dict[str, Any]]]) \
+        -> Optional[Dict[str, Dict[str, Any]]]:
+    """Compact per-attribute view for bench entries and CLI output."""
+    if not scorecards:
+        return None
+    return {attr: {
+        "cells_flagged": card.get("cells_flagged", 0),
+        "repair_rate": card.get("repair_rate", 0.0),
+        "low_confidence_fraction":
+            card.get("confidence", {}).get("low_confidence_fraction"),
+        "mean_confidence": card.get("confidence", {}).get("mean"),
+    } for attr, card in sorted(scorecards.items())}
+
+
+# -- recorder lifecycle ----------------------------------------------------
+
+# The process-wide active ledger. Written only by maybe_start/finalize;
+# instrumentation reads it with a single attribute load (same contract as
+# spans._current / the metrics registry).
+_ledger: Optional[ProvenanceLedger] = None
+
+
+def active_ledger() -> Optional[ProvenanceLedger]:
+    return _ledger
+
+
+def maybe_start(recorder: Any) -> None:
+    """Attaches a fresh ledger to the recorder when provenance is
+    configured. Called by ``start_recording``; nested runs keep the outer
+    run's ledger."""
+    global _ledger
+    if _ledger is not None:
+        return
+    path = provenance_path()
+    if not path:
+        return
+    _ledger = ProvenanceLedger(path)
+    recorder.provenance = _ledger
+    _logger.info(f"Provenance ledger active (path={path})")
+
+
+def scorecards_for(recorder: Any) -> Optional[Dict[str, Any]]:
+    """The recorder's scorecards: the finalized ones when available, else a
+    live aggregation of the in-flight ledger (the ``/report`` endpoint)."""
+    cards = getattr(recorder, "scorecards", None)
+    if cards is not None:
+        return cards
+    led = getattr(recorder, "provenance", None)
+    return led.scorecards() if led is not None else None
+
+
+def finalize(recorder: Any) -> None:
+    """Writes the ledger file and freezes the scorecards onto the recorder.
+    Idempotent: ``main.py`` calls it early (so the drift gate can run while
+    the live ``/metrics`` plane is still up) and ``stop_recording`` calls it
+    again."""
+    global _ledger
+    led = getattr(recorder, "provenance", None)
+    if led is None:
+        return
+    if getattr(recorder, "scorecards", None) is None:
+        recorder.scorecards = led.scorecards()
+    led.write()
+    if _ledger is led:
+        _ledger = None

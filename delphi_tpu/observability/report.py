@@ -2,11 +2,11 @@
 ``RepairModel.run()`` (and by ``bench.py``) when ``DELPHI_METRICS_PATH`` /
 ``repair.metrics.path`` is set.
 
-Schema (version 2; version 1 reports still load, see
+Schema (version 3; version 1/2 reports still load, see
 :func:`load_run_report`)::
 
     {
-      "schema_version": 2,
+      "schema_version": 3,
       "kind": "delphi_tpu.run_report",
       "created_at": "<ISO-8601 UTC>",
       "status": "ok" | "error" | "running",  # "running" from /report only
@@ -19,8 +19,18 @@ Schema (version 2; version 1 reports still load, see
       "per_process": null | {                # multi-host runs only
         "<rank>": {"process_index": 0,
                    "metrics": {...},         # that rank's own registry
-                   "spans": {...}}           # process-tagged span tree
-      }
+                   "spans": {...},           # process-tagged span tree
+                   "scorecards": {...}}      # that rank's own scorecards
+      },
+      "scorecards": null | {                 # v3+: provenance enabled only
+        "<attribute>": {cells_flagged, cells_repaired, repair_rate,
+                        detectors: {}, decisions: {},
+                        confidence: {count, sum, min, max, mean, bins: [],
+                                     low_confidence_fraction},
+                        domain_size: {count, sum, min, max, mean, hist: {}},
+                        repaired_values: {}, [model_cv_score]}
+      },
+      "drift": null | {...}                  # v3+: --baseline-report runs
     }
 
 On a multi-host cluster every rank's registry state and span tree travel
@@ -46,8 +56,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 2
-SUPPORTED_SCHEMA_VERSIONS = (1, 2)
+REPORT_SCHEMA_VERSION = 3
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -217,10 +227,13 @@ def gather_per_process(recorder: Any) -> None:
 
     if distributed.process_count() == 1:
         return
+    from delphi_tpu.observability.provenance import scorecards_for
+
     payload = {
         "process_index": distributed.process_index(),
         "metrics": recorder.registry.export_state(),
         "spans": recorder.root.to_dict(),
+        "scorecards": scorecards_for(recorder),
     }
     recorder.per_process = distributed.allgather_pickled(payload)
 
@@ -232,10 +245,12 @@ def _tag_process(span_dict: Dict[str, Any], rank: int) -> None:
 
 
 def _per_process_section(gathered: List[Dict[str, Any]]) \
-        -> Tuple[Dict[str, Any], Dict[str, Any]]:
-    """(per_process section, merged cluster-wide metrics) from the gathered
-    rank payloads. Ranks are keyed by gather order — ``allgather_pickled``
-    returns payloads in process order on every rank."""
+        -> Tuple[Dict[str, Any], Dict[str, Any], Optional[Dict[str, Any]]]:
+    """(per_process section, merged cluster-wide metrics, merged cluster-wide
+    scorecards) from the gathered rank payloads. Ranks are keyed by gather
+    order — ``allgather_pickled`` returns payloads in process order on every
+    rank."""
+    from delphi_tpu.observability.provenance import merge_scorecards
     from delphi_tpu.observability.registry import (
         merge_state_snapshots, state_snapshot)
 
@@ -243,6 +258,7 @@ def _per_process_section(gathered: List[Dict[str, Any]]) \
 
     section: Dict[str, Any] = {}
     states = []
+    cards = []
     for rank, payload in enumerate(gathered):
         # deep-copied before tagging: the tag mutates in place, and gathered
         # payloads may alias (this rank's own payload, or test fakes that
@@ -253,9 +269,12 @@ def _per_process_section(gathered: List[Dict[str, Any]]) \
             "process_index": rank,
             "metrics": state_snapshot(payload["metrics"]),
             "spans": spans,
+            "scorecards": payload.get("scorecards"),
         }
         states.append(payload["metrics"])
-    return section, merge_state_snapshots(states)
+        cards.append(payload.get("scorecards"))
+    merged_cards = merge_scorecards(cards) if any(cards) else None
+    return section, merge_state_snapshots(states), merged_cards
 
 
 def build_run_report(recorder: Any,
@@ -283,12 +302,15 @@ def build_run_report(recorder: Any,
                 if counts.get(s.name) == 1 and s.name in per_phase:
                     s.device_s = per_phase[s.name]
 
+    from delphi_tpu.observability.provenance import scorecards_for
+
     per_process = None
     gathered = getattr(recorder, "per_process", None)
     if gathered and len(gathered) > 1:
-        per_process, metrics = _per_process_section(gathered)
+        per_process, metrics, scorecards = _per_process_section(gathered)
     else:
         metrics = recorder.registry.snapshot()
+        scorecards = scorecards_for(recorder)
 
     return {
         "schema_version": REPORT_SCHEMA_VERSION,
@@ -303,11 +325,17 @@ def build_run_report(recorder: Any,
         "spans": root.to_dict(),
         "device_time": device_time,
         "per_process": per_process,
+        "scorecards": scorecards,
+        "drift": getattr(recorder, "drift", None),
     }
 
 
 def write_run_report(report: Dict[str, Any], path: str) -> None:
-    """Atomic-rename write so readers never see a torn report."""
+    """Atomic write: serialize to a same-directory temp file, fsync, then
+    ``os.replace`` over the destination — a run killed mid-write (or a
+    mid-write crash on a non-serializable report) never leaves a truncated
+    JSON for ``load_run_report`` to silently discard, and any pre-existing
+    report at ``path`` survives intact."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(prefix=".run_report_", dir=directory)
@@ -315,6 +343,8 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
         with os.fdopen(fd, "w") as f:
             json.dump(report, f, indent=2, sort_keys=False)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except Exception:
         try:
@@ -326,14 +356,17 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1 -> v2 upgrade: v2 only adds keys (``per_process``), so a
-    v1 report becomes a valid v2 one by defaulting them. Consumers can rely
-    on the v2 shape regardless of the file's age."""
+    """In-memory v1/v2 -> v3 upgrade: each version only adds keys (v2 added
+    ``per_process``, v3 added ``scorecards`` and ``drift``), so an older
+    report becomes a valid v3 one by defaulting them. Consumers can rely on
+    the v3 shape regardless of the file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
     report = dict(report)
-    report.setdefault("per_process", None)
+    report.setdefault("per_process", None)   # v1 -> v2
+    report.setdefault("scorecards", None)    # v2 -> v3
+    report.setdefault("drift", None)         # v2 -> v3
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

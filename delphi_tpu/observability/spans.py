@@ -81,6 +81,14 @@ class RunRecorder:
         # Gathered per-rank payloads (observability/report.py), filled at
         # stop_recording on multi-host clusters.
         self.per_process: Optional[List[Dict[str, Any]]] = None
+        # Provenance ledger (observability/provenance.py), attached by
+        # start_recording when DELPHI_PROVENANCE_PATH & co. are configured.
+        # `scorecards` freezes the aggregated per-attribute quality cards at
+        # provenance.finalize; `drift` holds the drift-gate verdict when
+        # main.py ran one against a baseline report.
+        self.provenance: Optional[Any] = None
+        self.scorecards: Optional[Dict[str, Any]] = None
+        self.drift: Optional[Dict[str, Any]] = None
         # Span-transition clock for the stall watchdog: perf_counter of the
         # last enter/exit plus a monotonically increasing transition count.
         self.last_transition = self._t0
@@ -213,6 +221,11 @@ def start_recording(name: str,
         # Telemetry must never take the run down with it.
         _logger.warning(f"live telemetry plane failed to start: {e}")
     try:
+        from delphi_tpu.observability import provenance
+        provenance.maybe_start(_current)
+    except Exception as e:
+        _logger.warning(f"provenance ledger failed to start: {e}")
+    try:
         # compile plane: apply cache-dir/threshold overrides and forward
         # jax compilation-cache events into this run's metrics registry
         from delphi_tpu.parallel import compile_plane
@@ -234,6 +247,15 @@ def stop_recording(recorder: Optional[RunRecorder]) -> None:
     except Exception as e:
         _logger.warning(f"compile-cache stats unavailable: {e}")
     recorder.finish()
+    try:
+        # Freeze the per-attribute scorecards and flush the ledger file
+        # before the multi-host gather below ships them cross-rank.
+        # Idempotent: main.py may already have finalized early so the drift
+        # gate could run while the live /metrics plane was still up.
+        from delphi_tpu.observability import provenance
+        provenance.finalize(recorder)
+    except Exception as e:
+        _logger.warning(f"provenance ledger failed to finalize: {e}")
     if recorder.live is not None:
         try:
             recorder.live.stop()

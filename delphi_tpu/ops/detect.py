@@ -26,7 +26,7 @@ import numpy as np
 from delphi_tpu.constraints import AttrRef, Constant, DenialConstraints, Predicate
 from delphi_tpu.session import AnalysisException
 from delphi_tpu.table import EncodedTable, NULL_CODE
-from delphi_tpu.observability import counter_inc
+from delphi_tpu.observability import active_ledger, counter_inc
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -852,6 +852,17 @@ def detect_constraint_violations(table: EncodedTable,
         rows = np.nonzero(mask)[0]
         if rows.size:
             counter_inc("detect.constraint_cells", rows.size * len(attrs))
+            led = active_ledger()
+            if led is not None:
+                # which specific constraint flagged the cell, not just
+                # "ConstraintErrorDetector": the ledger's detector label
+                # spells the predicate conjunction
+                label = "constraint[" \
+                    + "&".join(f"{p.sign}({p.left},{p.right})"
+                               for p in preds) + "]"
+                rids = table.row_id_values[rows]
+                for a in attrs:
+                    led.record_detection(label, rows, a, rids)
             for a in attrs:
                 out.append((rows, a))
     return out

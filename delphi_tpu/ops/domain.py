@@ -22,6 +22,7 @@ import os
 
 import numpy as np
 
+from delphi_tpu.observability import active_ledger
 from delphi_tpu.ops.freq import FreqStats
 from delphi_tpu.table import DiscretizedTable, NULL_CODE
 
@@ -69,6 +70,7 @@ def compute_domain_in_error_cells(
         attrs_all = np.array([a for _, a, _ in cells], dtype=object)
         curs_all = np.array([c for _, _, c in cells], dtype=object)
 
+    led = active_ledger()
     out: List[CellDomain] = []
     for group in _iter_attr_groups(
             disc, (rows_all, attrs_all, curs_all), continuous_attrs,
@@ -76,6 +78,9 @@ def compute_domain_in_error_cells(
             max_attrs_to_compute_domains, alpha):
         attr, rows, currents = group.attr, group.rows, group.currents
         if group.empty_domain:
+            if led is not None and len(rows):
+                led.record_domain_sizes(rows, attr, np.zeros(len(rows),
+                                                             dtype=np.int64))
             out.extend(CellDomain(int(r), attr, cur, [])
                        for r, cur in zip(rows, currents))
             continue
@@ -95,6 +100,9 @@ def compute_domain_in_error_cells(
                                 vocab_sel[order].tolist(),
                                 probs_sel[order].tolist()):
                 doms[ci].append((str(v), float(p)))
+            if led is not None and len(sub_rows):
+                led.record_domain_sizes(sub_rows, attr,
+                                        keep_mask.sum(axis=1))
             for i, r in enumerate(sub_rows):
                 cur = currents[lo + i]
                 out.append(CellDomain(int(r), attr, cur, doms[i]))
@@ -236,6 +244,7 @@ def compute_weak_label_mask(
     mesh = None if getattr(disc.table, "process_local", False) \
         else get_active_mesh()
     table = disc.table
+    led = active_ledger()
     demote = np.zeros(len(cells[0]), dtype=bool)
 
     for group in _iter_attr_groups(
@@ -243,6 +252,10 @@ def compute_weak_label_mask(
             pairwise_stats, domain_stats, max_attrs_to_compute_domains,
             alpha):
         if group.empty_domain:
+            if led is not None and len(group.rows):
+                led.record_domain_sizes(
+                    group.rows, group.attr,
+                    np.zeros(len(group.rows), dtype=np.int64))
             continue  # empty domain -> never demoted
         vocab = table.column(group.attr).vocab
         vocab_str = np.array([str(v) for v in vocab], dtype=object)
@@ -262,7 +275,11 @@ def compute_weak_label_mask(
         # phase-1 cost at the 1e8-row north star was exactly these host
         # passes over [cells, v_a] matrices. Same int32/float64 contract as
         # the other routes (bit-identical demotions).
-        fused = mesh is None \
+        # the fused kernel returns only per-cell scalars, so the provenance
+        # ledger's per-cell domain sizes are unavailable on that route —
+        # ledger-enabled runs take the score_chunks path (an opt-in cost,
+        # like every other provenance hook)
+        fused = mesh is None and led is None \
             and len(pair_tables) * max(max_count, 1) < 2 ** 31 \
             and (len(group.rows) >= 65536
                  or os.environ.get("DELPHI_DOMAIN_DEVICE") == "1")
@@ -276,7 +293,11 @@ def compute_weak_label_mask(
             continue
 
         for lo, prob, contributed in group.score_chunks():
-            masked = np.where(contributed & (prob > beta), prob, -np.inf)
+            keep = contributed & (prob > beta)
+            if led is not None and len(prob):
+                led.record_domain_sizes(group.rows[lo:lo + len(prob)],
+                                        group.attr, keep.sum(axis=1))
+            masked = np.where(keep, prob, -np.inf)
             best_p = masked.max(axis=1)
             has_domain = best_p > -np.inf
             ties = masked == best_p[:, None]

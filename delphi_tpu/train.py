@@ -16,7 +16,8 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import pandas as pd
 
-from delphi_tpu.observability import counter_inc, histogram_observe
+from delphi_tpu.observability import (active_ledger, counter_inc,
+                                      histogram_observe)
 from delphi_tpu.utils import elapsed_time, get_option_value, setup_logger
 
 _logger = setup_logger()
@@ -370,6 +371,19 @@ def _trimmed_grid(is_discrete: bool, num_class: int, max_evals: int,
     return grid
 
 
+def _record_model_scores(
+        results: Dict[str, Tuple[Tuple[Any, float], float]]) \
+        -> Dict[str, Tuple[Tuple[Any, float], float]]:
+    """Lands each target's CV score in the provenance ledger (it surfaces
+    as ``model_cv_score`` on the attribute's quality scorecard)."""
+    led = active_ledger()
+    if led is not None:
+        for name, ((model, score), _elapsed) in results.items():
+            if model is not None:
+                led.record_model_score(name, score)
+    return results
+
+
 def build_models_batched(tasks: list, opts: Dict[str, str]) \
         -> Dict[str, Tuple[Tuple[Any, float], float]]:
     """Builds MANY per-attribute repair models with batched device work —
@@ -405,7 +419,7 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
                 results[name] = build_model(
                     X, y, is_discrete, num_class, -1, opts)
         if not gbdt_tasks:
-            return results
+            return _record_model_scores(results)
 
         def opt(*args):  # type: ignore
             return get_option_value(opts, *args)
@@ -529,7 +543,7 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
             score = score if m is not None and np.isfinite(score) \
                 else (-m.loss_ if m is not None else 0.0)
             results[name] = ((m, score), elapsed_each)
-        return results
+        return _record_model_scores(results)
     except Exception as e:
         # total batched-path failure: every unresolved task falls back to
         # the sequential builder (never silently drop a target)
@@ -541,7 +555,7 @@ def build_models_batched(tasks: list, opts: Dict[str, str]) \
             if name not in results:
                 results[name] = build_model(
                     X, y, is_discrete, num_class, -1, opts)
-        return results
+        return _record_model_scores(results)
 
 
 def compute_class_nrow_stdv(y: pd.Series, is_discrete: bool) -> Optional[float]:

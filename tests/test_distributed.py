@@ -531,7 +531,7 @@ def test_report_merges_faked_two_process_run(monkeypatch):
     assert recorder.per_process is not None and len(recorder.per_process) == 2
 
     report = obs.build_run_report(recorder, run={}, status="ok")
-    assert report["schema_version"] == 2
+    assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
     per_process = report["per_process"]
     assert sorted(per_process) == ["0", "1"]
     for rank, entry in per_process.items():

@@ -1,0 +1,31 @@
+"""Environment-flag audit: every ``DELPHI_*`` knob the library reads must
+be documented under ``docs/source/`` — an undocumented flag is a feature
+nobody can discover. Grep-based on purpose: the audit catches flags added
+anywhere in the package, not just in blessed registries."""
+
+import pathlib
+import re
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+FLAG_RE = re.compile(r"DELPHI_[A-Z][A-Z0-9_]*")
+
+
+def _flags_in(root: pathlib.Path, suffixes) -> set:
+    found = set()
+    for path in root.rglob("*"):
+        if path.suffix not in suffixes or not path.is_file():
+            continue
+        found.update(FLAG_RE.findall(path.read_text(errors="replace")))
+    return found
+
+
+def test_every_env_flag_is_documented():
+    source_flags = _flags_in(REPO_ROOT / "delphi_tpu", {".py"})
+    assert len(source_flags) >= 30, \
+        f"flag grep looks broken: only found {sorted(source_flags)}"
+    documented = _flags_in(REPO_ROOT / "docs" / "source", {".rst"})
+    missing = sorted(source_flags - documented)
+    assert not missing, (
+        "environment flags read by delphi_tpu/ but not documented in "
+        f"docs/source/: {missing} — add them to the flag tables in "
+        "observability.rst / performance.rst / scaling.rst / internals.rst")

@@ -1,0 +1,328 @@
+"""Repair provenance plane: the per-cell ledger, the per-attribute quality
+scorecards it aggregates into (run-report schema v3), the cross-run drift
+gate, and the ``report-diff`` CLI. The end-to-end test checks the ISSUE's
+acceptance bar: with ``DELPHI_PROVENANCE_PATH`` set, every row of the
+repair output has a matching ledger entry carrying detector, domain size,
+top-k posterior, and decision reason."""
+
+import json
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import observability as obs
+from delphi_tpu.model import RepairModel
+from delphi_tpu.observability import drift, provenance
+from delphi_tpu.observability.diff import main as diff_main
+from delphi_tpu.observability.live import render_prometheus
+from delphi_tpu.observability.provenance import (
+    DECISION_KEPT, DECISION_REPAIRED, REASON_CONFIDENCE_UNAVAILABLE,
+    REASON_DC_MINIMIZED, REASON_MODEL_REPAIR, ProvenanceLedger,
+    build_scorecards, merge_scorecards, scorecard_summary)
+from delphi_tpu.observability.registry import MetricsRegistry
+from delphi_tpu.observability.spans import RunRecorder
+
+
+def _tiny_df(n: int = 60) -> pd.DataFrame:
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "c0": rng.choice(["a", "b", "c"], n),
+        "c1": rng.choice(["x", "y"], n),
+        "c2": rng.choice(["p", "q", "r"], n),
+    })
+    df.loc[df["c0"] == "a", "c1"] = "x"  # learnable signal for the c1 model
+    df.loc[5:9, "c1"] = None
+    return df
+
+
+@pytest.fixture
+def tiny(session):
+    session.register("provenance_tiny", _tiny_df())
+    yield
+    obs.stop_recording(obs.current_recorder())
+    provenance._ledger = None  # never leak a ledger into later tests
+
+
+def test_disabled_is_one_pointer_check(monkeypatch):
+    monkeypatch.delenv("DELPHI_PROVENANCE_PATH", raising=False)
+    assert not provenance.provenance_configured()
+    # the whole disabled-path cost at every instrumentation site:
+    assert provenance.active_ledger() is None
+
+
+def test_e2e_ledger_covers_every_update(tiny, tmp_path, monkeypatch):
+    ledger_path = tmp_path / "ledger.jsonl"
+    report_path = tmp_path / "report.json"
+    monkeypatch.setenv("DELPHI_PROVENANCE_PATH", str(ledger_path))
+    monkeypatch.setenv("DELPHI_METRICS_PATH", str(report_path))
+
+    repaired = delphi.repair \
+        .setTableName("provenance_tiny").setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]).run()
+    assert len(repaired) == 5
+    assert provenance.active_ledger() is None  # detached at stop_recording
+
+    entries = {(e["row_id"], e["attribute"]): e
+               for e in map(json.loads, ledger_path.read_text().splitlines())}
+    assert entries
+    # acceptance bar: every output updates row has a matching ledger entry
+    # with detector, domain size, top-k posterior, and decision reason
+    for _, row in repaired.iterrows():
+        e = entries[(str(row["tid"]), row["attribute"])]
+        assert e["detectors"], e
+        assert e["decision"] == DECISION_REPAIRED
+        assert e["decision_reason"], e
+        assert e["domain_size"] >= 1
+        assert e["top_k"] and e["top_k"][0]["value"] is not None
+        assert e["repaired"] == str(row["repaired"])
+    # and a repaired cell's top-k carries actual probabilities
+    some = entries[(str(repaired.iloc[0]["tid"]),
+                    repaired.iloc[0]["attribute"])]
+    assert any(t["prob"] is not None for t in some["top_k"])
+
+    # scorecards landed in the v3 report
+    report = obs.load_run_report(str(report_path))
+    assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    cards = report["scorecards"]
+    assert cards and "c1" in cards
+    assert cards["c1"]["cells_repaired"] == 5
+    assert cards["c1"]["repair_rate"] > 0
+    assert sum(cards["c1"]["confidence"]["bins"]) == \
+        cards["c1"]["confidence"]["count"]
+    assert cards["c1"]["domain_size"]["count"] > 0
+    summary = scorecard_summary(cards)
+    assert summary["c1"]["cells_flagged"] == cards["c1"]["cells_flagged"]
+
+
+def test_memory_ledger_writes_no_file(tiny, tmp_path, monkeypatch):
+    report_path = tmp_path / "report.json"
+    monkeypatch.setenv("DELPHI_PROVENANCE_PATH", ":memory:")
+    monkeypatch.setenv("DELPHI_METRICS_PATH", str(report_path))
+    delphi.repair \
+        .setTableName("provenance_tiny").setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]).run()
+    report = obs.load_run_report(str(report_path))
+    assert report["scorecards"]  # scorecards exist without any ledger file
+    assert list(tmp_path.iterdir()) == [report_path]
+
+
+def test_ledger_sticky_reasons_and_defaults():
+    led = ProvenanceLedger(":memory:")
+    led.record_detection("NullErrorDetector()", [0, 1], "c1", ["r0", "r1"])
+    led.record_domain_sizes([0, 1], "c1", [4, 7])
+    led.record_posterior("c1", ["r0", "r1"], ["x", "y"],
+                         [[0.9, 0.1], [0.2, 0.8]])
+    # a specific early pass records a sticky reason for r0...
+    led.record_decision("r0", "c1", DECISION_KEPT, REASON_DC_MINIMIZED)
+    # ...which the later generic extraction pass must not overwrite
+    led.record_decisions(["r0", "r1"], "c1", DECISION_REPAIRED,
+                         REASON_MODEL_REPAIR, repaired=["x", "y"],
+                         sticky_aware=True)
+    by_id = {e["row_id"]: e for e in led.entries()}
+    assert by_id["r0"]["decision"] == DECISION_REPAIRED  # decision updates
+    assert by_id["r0"]["decision_reason"] == REASON_DC_MINIMIZED  # sticky
+    assert by_id["r1"]["decision_reason"] == REASON_MODEL_REPAIR
+    assert by_id["r0"]["domain_size"] == 4
+    assert by_id["r0"]["top_k"][0] == {"value": "x", "prob": 0.9}
+    # clear_decision -> entries() fills the defaults back in
+    led.clear_decision("r0", "c1")
+    by_id = {e["row_id"]: e for e in led.entries()}
+    assert by_id["r0"]["decision"] == DECISION_KEPT
+    assert by_id["r0"]["decision_reason"] == \
+        provenance.REASON_NO_REPAIR_ATTEMPTED
+
+
+def _entries(n, attr, conf, value):
+    return [{"row_id": str(i), "attribute": attr, "confidence": conf,
+             "detectors": ["d"], "domain_size": 4,
+             "decision": DECISION_REPAIRED,
+             "decision_reason": REASON_MODEL_REPAIR, "repaired": value}
+            for i in range(n)]
+
+
+def _round_floats(obj, digits=9):
+    if isinstance(obj, float):
+        return round(obj, digits)
+    if isinstance(obj, dict):
+        return {k: _round_floats(v, digits) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_round_floats(v, digits) for v in obj]
+    return obj
+
+
+def test_scorecard_merge_matches_single_build():
+    a = _entries(10, "c1", 0.9, "x")
+    b = _entries(30, "c1", 0.3, "y") + _entries(5, "c2", 0.7, "p")
+    merged = merge_scorecards([build_scorecards(a), build_scorecards(b)])
+    whole = build_scorecards(a + b)
+    # exact merge incl. recomputed derived fields (modulo float addition
+    # order in the confidence sums)
+    assert _round_floats(merged) == _round_floats(whole)
+    assert merged["c1"]["cells_flagged"] == 40
+    assert merged["c1"]["repair_rate"] == 1.0
+    assert merged["c1"]["confidence"]["low_confidence_fraction"] == 0.75
+    assert merged["c1"]["repaired_values"] == {"x": 10, "y": 30}
+
+
+def test_drift_identical_runs_do_not_trip():
+    cards = build_scorecards(_entries(20, "c1", 0.9, "x"))
+    baseline = {"scorecards": cards}
+    result = drift.evaluate(cards, baseline, fail_over=0.01)
+    assert result["max_divergence"] == 0.0
+    assert result["failed"] is False
+    assert result["baseline_missing"] is False
+
+
+def test_drift_shifted_run_trips_gate_and_gauges():
+    baseline_cards = build_scorecards(_entries(50, "c1", 0.9, "x"))
+    shifted_cards = build_scorecards(_entries(50, "c1", 0.15, "y"))
+    recorder = RunRecorder("drift_test")
+    result = drift.evaluate(shifted_cards, {"scorecards": baseline_cards},
+                            fail_over=0.25, registry=recorder.registry)
+    assert result["max_confidence_psi"] > 0.25
+    assert result["max_repair_value_js"] > 0.25
+    assert result["failed"] is True
+    gauges = recorder.registry.snapshot()["gauges"]
+    assert gauges["drift.max_divergence"] == result["max_divergence"]
+    assert gauges["drift.c1.confidence_psi"] == \
+        result["per_attribute"]["c1"]["confidence_psi"]
+    assert gauges["drift.failed"] == 1.0
+    # the live plane's /metrics body carries the same gauges
+    recorder.finish()
+    prom = render_prometheus(recorder)
+    assert "delphi_drift_max_divergence" in prom
+    assert "delphi_drift_failed 1" in prom
+
+
+def test_drift_v2_baseline_never_fails():
+    cards = build_scorecards(_entries(5, "c1", 0.9, "x"))
+    v2_baseline = {"schema_version": 2, "metrics": {}, "scorecards": None}
+    result = drift.evaluate(cards, v2_baseline, fail_over=0.0)
+    assert result["baseline_missing"] is True
+    assert result["failed"] is False
+
+
+class _Pred:
+    """One-tuple DC predicate stub: only .sign/.references/.right.literal
+    are read by _minimize_one_tuple_dc_repairs."""
+
+    def __init__(self, attr, literal, sign="EQ"):
+        self.sign = sign
+        self.references = [attr]
+        self.right = types.SimpleNamespace(literal=literal)
+
+
+def _dc_fixture():
+    # row r0 violates EQ(c0,a) & EQ(c1,x); the models repaired both cells
+    table = types.SimpleNamespace(row_id_values=np.array(["r0"], dtype=object))
+    plan = {
+        "flagged": {0: {"c0": "a", "c1": "x"}},
+        "protected": set(),
+        "kinds": {},
+        "plans": [([_Pred("c0", "a"), _Pred("c1", "x")], np.array([0]))],
+    }
+    pos = np.array([0])
+    repaired = pd.DataFrame({"c0": ["b"], "c1": ["y"], "f": ["z"]})
+    return table, plan, pos, repaired
+
+
+class _RaisingModel:
+    classes_ = np.array(["b"])
+
+    def predict_proba(self, X):
+        raise RuntimeError("no confidence available")
+
+
+class _ConstModel:
+    def __init__(self, classes, probs):
+        self.classes_ = np.array(classes)
+        self._probs = probs
+
+    def predict_proba(self, X):
+        return np.tile(np.asarray(self._probs, dtype=np.float64),
+                       (len(X), 1))
+
+
+def _with_memory_ledger(monkeypatch):
+    led = ProvenanceLedger(":memory:")
+    monkeypatch.setattr(provenance, "_ledger", led)
+    return led
+
+
+def test_batch_confidence_failure_keeps_all_repairs(monkeypatch):
+    """model.py's "confidence unavailable -> keep all repairs" fallback:
+    a model whose predict_proba raises disables minimization for the plan
+    and every repair survives, recorded with the distinct sticky reason."""
+    led = _with_memory_ledger(monkeypatch)
+    table, plan, pos, repaired = _dc_fixture()
+    models = [("c0", (_RaisingModel(), ["f"], None)),
+              ("c1", (_RaisingModel(), ["f"], None))]
+    out = RepairModel()._minimize_one_tuple_dc_repairs(
+        table, plan, pos, repaired.copy(), models)
+    assert out["c0"].iloc[0] == "b" and out["c1"].iloc[0] == "y"
+    by_attr = {e["attribute"]: e for e in led.entries()}
+    for attr in ("c0", "c1"):
+        assert by_attr[attr]["decision"] == DECISION_REPAIRED
+        assert by_attr[attr]["decision_reason"] == \
+            REASON_CONFIDENCE_UNAVAILABLE
+
+
+def test_batch_confidence_nan_row_keeps_all_repairs(monkeypatch):
+    """Per-row fallback: predict_proba works but the repaired value is not
+    in classes_ (NaN confidence) -> that row keeps every repair."""
+    led = _with_memory_ledger(monkeypatch)
+    table, plan, pos, repaired = _dc_fixture()
+    models = [("c0", (_ConstModel(["ZZZ"], [1.0]), ["f"], None)),
+              ("c1", (_ConstModel(["ZZZ"], [1.0]), ["f"], None))]
+    out = RepairModel()._minimize_one_tuple_dc_repairs(
+        table, plan, pos, repaired.copy(), models)
+    assert out["c0"].iloc[0] == "b" and out["c1"].iloc[0] == "y"
+    assert {e["decision_reason"] for e in led.entries()} == \
+        {REASON_CONFIDENCE_UNAVAILABLE}
+
+
+def test_dc_minimization_reverts_and_records(monkeypatch):
+    """Control case: usable confidences -> keep the best repair, revert the
+    other to its current value, and record the revert in the ledger."""
+    led = _with_memory_ledger(monkeypatch)
+    table, plan, pos, repaired = _dc_fixture()
+    models = [("c0", (_ConstModel(["b", "x"], [0.9, 0.1]), ["f"], None)),
+              ("c1", (_ConstModel(["y", "x"], [0.2, 0.8]), ["f"], None))]
+    out = RepairModel()._minimize_one_tuple_dc_repairs(
+        table, plan, pos, repaired.copy(), models)
+    assert out["c0"].iloc[0] == "b"    # the confident repair is kept
+    assert out["c1"].iloc[0] == "x"    # reverted to its current value
+    by_attr = {e["attribute"]: e for e in led.entries()}
+    assert by_attr["c1"]["decision"] == DECISION_KEPT
+    assert by_attr["c1"]["decision_reason"] == REASON_DC_MINIMIZED
+
+
+def _report(path, gauges=None, cards=None):
+    recorder = RunRecorder("diff_test")
+    for name, v in (gauges or {}).items():
+        recorder.registry.set_gauge(name, v)
+    recorder.finish()
+    if cards is not None:
+        recorder.scorecards = cards
+    report = obs.build_run_report(recorder, run={}, status="ok")
+    obs.write_run_report(report, str(path))
+
+
+def test_report_diff_cli(tmp_path, capsys):
+    _report(tmp_path / "base.json", gauges={"pipeline.error_cells": 10},
+            cards=build_scorecards(_entries(10, "c1", 0.9, "x")))
+    _report(tmp_path / "cur.json", gauges={"pipeline.error_cells": 40},
+            cards=build_scorecards(_entries(40, "c1", 0.4, "y")))
+    assert diff_main([str(tmp_path / "base.json"),
+                      str(tmp_path / "cur.json")]) == 0
+    out = capsys.readouterr().out
+    assert "pipeline.error_cells: 10 -> 40 (30)" in out
+    assert "scorecard drift" in out
+    assert "max divergence" in out
+
+    assert diff_main([str(tmp_path / "base.json"),
+                      str(tmp_path / "missing.json")]) == 2

@@ -240,15 +240,46 @@ def test_v1_report_upgrades_on_load(tmp_path):
     path.write_text(json.dumps(v1))
     loaded = obs.load_run_report(str(path))
     assert loaded is not None
-    assert loaded["schema_version"] == 2
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
     assert loaded["schema_version_loaded_from"] == 1
     assert loaded["per_process"] is None
+    assert loaded["scorecards"] is None
+    assert loaded["drift"] is None
     assert loaded["metrics"] == {"counters": {}}  # payload untouched
 
     unknown = {"schema_version": 99, "kind": obs.REPORT_KIND}
     path2 = tmp_path / "v99.json"
     path2.write_text(json.dumps(unknown))
     assert obs.load_run_report(str(path2)) is None
+
+
+def test_v2_report_upgrades_on_load(tmp_path):
+    v2 = {"schema_version": 2, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"},
+          "per_process": None}
+    path = tmp_path / "v2.json"
+    path.write_text(json.dumps(v2))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert loaded["schema_version_loaded_from"] == 2
+    assert loaded["scorecards"] is None
+    assert loaded["drift"] is None
+
+
+def test_write_run_report_is_atomic(tmp_path):
+    """A failed serialization must not clobber an existing report: the write
+    goes to a temp file that is os.replace'd only on success."""
+    path = tmp_path / "report.json"
+    obs.write_run_report({"schema_version": obs.REPORT_SCHEMA_VERSION,
+                          "kind": obs.REPORT_KIND, "ok": True}, str(path))
+    before = path.read_text()
+    with pytest.raises(TypeError):
+        obs.write_run_report({"bad": object()}, str(path))
+    assert path.read_text() == before  # original intact
+    # no temp-file litter next to the report
+    leftovers = [p for p in path.parent.iterdir() if p.name != "report.json"]
+    assert leftovers == []
 
 
 def test_session_typed_conf_lookup(session):
