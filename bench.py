@@ -334,6 +334,85 @@ def smoke() -> int:
         print("smoke FAILED: warm run recorded no compile-cache hits",
               file=sys.stderr)
         return 1
+    return transfer_smoke(df)
+
+
+def transfer_smoke(df) -> int:
+    """Device-resident table plane A/B: the same tiny repair with
+    DELPHI_DEVICE_TABLE=0 (legacy per-chunk upload) vs the resident default
+    must record strictly fewer `transfer.bytes` AND `transfer.calls` on the
+    resident side, with bit-identical output frames and less wall time
+    spent in the weak-label/domain phases' uploads. DELPHI_DOMAIN_DEVICE=1
+    forces the device scoring route on both sides (the 64-row frame is far
+    below the size gate, and a numpy-vs-device comparison would measure
+    nothing)."""
+    import time
+
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.session import get_session
+
+    def one_run(tag: str, device_table: str) -> dict:
+        _heartbeat(f"transfer smoke {tag} run")
+        os.environ["DELPHI_DEVICE_TABLE"] = device_table
+        os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+        name = f"xfer_smoke_{tag}"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.transfer.{tag}")
+        t0 = time.perf_counter()
+        try:
+            out = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+            del os.environ["DELPHI_DEVICE_TABLE"]
+            del os.environ["DELPHI_DOMAIN_DEVICE"]
+        counters = rec.registry.snapshot()["counters"]
+        return {
+            "bytes": int(counters.get("transfer.bytes", 0)),
+            "calls": int(counters.get("transfer.calls", 0)),
+            "reuses": int(counters.get("transfer.reuses", 0)),
+            "bucket_launches": int(
+                counters.get("domain.bucket_launches", 0)),
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "frame": out.sort_values(list(out.columns))
+            .reset_index(drop=True),
+        }
+
+    legacy = one_run("legacy", "0")
+    resident = one_run("resident", "1")
+
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(legacy["frame"], resident["frame"])
+    except AssertionError:
+        frames_equal = False
+    for r in (legacy, resident):
+        del r["frame"]
+
+    ok = resident["bytes"] < legacy["bytes"] \
+        and resident["calls"] < legacy["calls"] \
+        and resident["bucket_launches"] > 0 \
+        and frames_equal
+    print(json.dumps({
+        "metric": "transfer_smoke",
+        "value": legacy["bytes"] - resident["bytes"],
+        "unit": "bytes saved", "vs_baseline": None, "ok": ok,
+        "legacy": legacy, "resident": resident,
+        "frames_equal": frames_equal,
+    }), flush=True)
+    if not ok:
+        print("smoke FAILED: device-resident path must move strictly fewer "
+              f"transfer bytes/calls than legacy with identical repairs "
+              f"(legacy={legacy}, resident={resident}, "
+              f"frames_equal={frames_equal})", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -2421,6 +2421,11 @@ class RepairModel:
 
         gauge_set("pipeline.input_rows", table.n_rows)
         gauge_set("pipeline.input_columns", len(table.columns))
+        # Surface the device-resident table plane's state in every run
+        # report / live scrape so transfer-ledger numbers are interpretable
+        # (the A/B toggle is DELPHI_DEVICE_TABLE, see ops/xfer.py).
+        from delphi_tpu.ops import xfer
+        gauge_set("device_table.enabled", int(xfer.device_table_enabled()))
         run_info.update({
             "input_table": input_name,
             "n_rows": int(table.n_rows),

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from delphi_tpu.ops.xfer import to_device
 from delphi_tpu.utils.native import get_qgram
 
 FEATURE_DIM = 1024
@@ -111,7 +112,7 @@ def kmeans(X: np.ndarray, k: int, seed: int = 0, n_iters: int = 20) -> np.ndarra
             centers.append(X[rng.randint(n)])
         else:
             centers.append(X[rng.choice(n, p=d / total)])
-    init = jnp.asarray(np.stack(centers))
+    init = to_device(np.stack(centers))
     # pad rows to the next power of two so subcluster splits of varying
     # sizes reuse one compiled program per (bucket, k)
     target = max(8, 1 << (n - 1).bit_length())
@@ -119,7 +120,7 @@ def kmeans(X: np.ndarray, k: int, seed: int = 0, n_iters: int = 20) -> np.ndarra
         [X, np.zeros((target - n,) + X.shape[1:], X.dtype)], axis=0)
     mask = np.concatenate(
         [np.ones(n, X.dtype), np.zeros(target - n, X.dtype)])
-    labels = _kmeans_jax(jnp.asarray(Xp), jnp.asarray(mask), init, k, n_iters)
+    labels = _kmeans_jax(to_device(Xp), to_device(mask), init, k, n_iters)
     return np.asarray(labels, dtype=np.int64)[:n]
 
 

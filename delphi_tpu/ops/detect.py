@@ -27,6 +27,7 @@ from delphi_tpu.constraints import AttrRef, Constant, DenialConstraints, Predica
 from delphi_tpu.session import AnalysisException
 from delphi_tpu.table import EncodedTable, NULL_CODE
 from delphi_tpu.observability import active_ledger, counter_inc
+from delphi_tpu.ops.xfer import to_device
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -90,6 +91,12 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
     process_local = getattr(table, "process_local", False)
     out = []
     attrs = [a for a in continuous_attrs if a in target_attrs]
+    # Pass 1 — assemble every attribute's percentile pool (sampling /
+    # process-local gathers preserved per attribute). Pass 2 — compute ALL
+    # device-eligible fences in ONE padded nanpercentile launch instead of
+    # a kernel launch per attribute: pools pad to [attrs, longest] with NaN
+    # and reduce along axis 1; host-eligible pools keep np.percentile.
+    pools: List[Tuple[str, Any, np.ndarray, np.ndarray, np.ndarray]] = []
     for attr in attrs:
         col = table.column(attr)
         assert col.numeric is not None
@@ -133,16 +140,33 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
             # (choice(replace=False) would permute the whole column)
             rng = np.random.RandomState(42)
             pool = pool[rng.randint(0, len(pool), APPROX_PERCENTILE_SAMPLE)]
-        if _use_device_detect(len(pool)):
-            # exact percentiles as one device sort — the full-column scan
-            # stays off the host on TPU (ErrorDetectorApi.scala:249-300 runs
-            # it as a distributed percentile job); x64 keeps the fences
-            # bit-compatible with the host np.percentile
-            import jax.numpy as jnp
-            from jax import enable_x64
-            with enable_x64():
-                q1, q3 = np.asarray(jnp.percentile(
-                    jnp.asarray(pool), jnp.asarray([25.0, 75.0])))
+        pools.append((attr, col, values, valid, pool))
+
+    # Device-eligible fences: pools batch into one [attrs, longest] NaN-
+    # padded matrix and ONE nanpercentile launch computes every q1/q3 —
+    # the full-column scans stay off the host on TPU (ErrorDetectorApi.
+    # scala:249-300 runs them as distributed percentile jobs) and the
+    # launch count is O(1) in the number of continuous attributes.
+    fences = {}
+    device_pools = [p for p in pools if _use_device_detect(len(p[4]))]
+    if device_pools:
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+        longest = max(len(p[4]) for p in device_pools)
+        padded = np.full((len(device_pools), longest), np.nan,
+                         dtype=np.float64)
+        for i, (_, _, _, _, pool) in enumerate(device_pools):
+            padded[i, :len(pool)] = pool
+        with enable_x64():
+            qs = np.asarray(jnp.nanpercentile(
+                to_device(padded),
+                to_device(np.asarray([25.0, 75.0])), axis=1))
+        for i, (attr, _, _, _, _) in enumerate(device_pools):
+            fences[attr] = (qs[0, i], qs[1, i])
+
+    for attr, col, values, valid, pool in pools:
+        if attr in fences:
+            q1, q3 = fences[attr]
         else:
             q1, q3 = np.percentile(pool, [25.0, 75.0])
         lower = q1 - 1.5 * (q3 - q1)
@@ -268,17 +292,17 @@ def _device_x64_ok() -> bool:
         try:
             import jax
             import jax.numpy as jnp
-            from jax import enable_x64
+            from jax.experimental import enable_x64
             with enable_x64():
-                keys = jnp.asarray(
+                keys = to_device(
                     np.array([3, (1 << 40) + 1, 1 << 40], dtype=np.int64))
                 s = jnp.sort(keys)
                 hits = jnp.searchsorted(s, keys, side="right") \
                     - jnp.searchsorted(s, keys, side="left")
-                vals = jnp.asarray(
+                vals = to_device(
                     np.array([1.0 + 2.0 ** -40, 1.0], dtype=np.float64))
                 ext = jax.ops.segment_max(
-                    vals, jnp.asarray(np.array([0, 0], dtype=np.int64)),
+                    vals, to_device(np.array([0, 0], dtype=np.int64)),
                     num_segments=1)
                 jax.block_until_ready((s, hits, ext))
                 ok = (s.dtype == jnp.int64
@@ -376,7 +400,7 @@ def _device_fused_ranks(halves: Sequence[Tuple[np.ndarray, np.ndarray]],
     padded device array instead of the sliced (first, second) host pair."""
     global _rank_kernel
     import jax.numpy as jnp
-    from jax import enable_x64
+    from jax.experimental import enable_x64
 
     if _rank_kernel is None:
         _rank_kernel = _jit_rank()
@@ -390,9 +414,9 @@ def _device_fused_ranks(halves: Sequence[Tuple[np.ndarray, np.ndarray]],
                 # padding sorts last (big), so real ranks land in [0, 2n)
                 # and the padding rows rank to exactly 2n — strictly above
                 # every real key at every later iteration too
-                key = jnp.asarray(_pad_pow2(both, big))
+                key = to_device(_pad_pow2(both, big))
             else:
-                key = inv * stride + jnp.asarray(_pad_pow2(both, 0))
+                key = inv * stride + to_device(_pad_pow2(both, 0))
             inv = _rank_kernel(key)
         if return_inv:
             return inv
@@ -427,7 +451,7 @@ def _device_sorted_count(keys2: np.ndarray, keys1: np.ndarray) -> np.ndarray:
     would truncate them to int32 and collide groups at scale."""
     global _sorted_count_kernel
     import jax.numpy as jnp
-    from jax import enable_x64
+    from jax.experimental import enable_x64
 
     if _sorted_count_kernel is None:
         _sorted_count_kernel = _jit_sorted_count()
@@ -435,8 +459,8 @@ def _device_sorted_count(keys2: np.ndarray, keys1: np.ndarray) -> np.ndarray:
     big = np.iinfo(np.int64).max
     with enable_x64():
         out = _sorted_count_kernel(
-            jnp.asarray(_pad_pow2(keys2.astype(np.int64), big)),
-            jnp.asarray(_pad_pow2(keys1.astype(np.int64), big - 1)))
+            to_device(_pad_pow2(keys2.astype(np.int64), big)),
+            to_device(_pad_pow2(keys1.astype(np.int64), big - 1)))
         out = np.asarray(out)
     return out[:n]
 
@@ -450,7 +474,7 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
     the host path)."""
     global _group_extrema_kernel
     import jax.numpy as jnp
-    from jax import enable_x64
+    from jax.experimental import enable_x64
 
     if _group_extrema_kernel is None:
         _group_extrema_kernel = _jit_group_extrema()
@@ -462,7 +486,7 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
     seg_pad = max(8, 1 << (max(n_groups + 1, 1) - 1).bit_length())
     with enable_x64():
         out = np.asarray(_group_extrema_kernel(
-            jnp.asarray(v), jnp.asarray(g), seg_pad, want_max))
+            to_device(v), to_device(g), seg_pad, want_max))
     return out[:n_groups]
 
 

@@ -22,8 +22,9 @@ import os
 
 import numpy as np
 
-from delphi_tpu.observability import active_ledger
+from delphi_tpu.observability import active_ledger, counter_inc
 from delphi_tpu.ops.freq import FreqStats
+from delphi_tpu.ops.xfer import to_device
 from delphi_tpu.table import DiscretizedTable, NULL_CODE
 
 
@@ -72,10 +73,20 @@ def compute_domain_in_error_cells(
 
     led = active_ledger()
     out: List[CellDomain] = []
-    for group in _iter_attr_groups(
-            disc, (rows_all, attrs_all, curs_all), continuous_attrs,
-            target_attrs, freq, pairwise_stats, domain_stats,
-            max_attrs_to_compute_domains, alpha):
+    groups = list(_iter_attr_groups(
+        disc, (rows_all, attrs_all, curs_all), continuous_attrs,
+        target_attrs, freq, pairwise_stats, domain_stats,
+        max_attrs_to_compute_domains, alpha))
+    # Device-resident default: every int32-safe group's chunks score through
+    # the shape-bucketed batched launcher (one launch per bucket, results
+    # bit-identical to the legacy chunk routes via _combine_scores).
+    bucket_results: Dict[int, list] = {}
+    if _bucketed_enabled(table):
+        jobs = [(gi, g, None, False) for gi, g in enumerate(groups)
+                if not g.empty_domain and _int32_safe_group(g)]
+        if jobs:
+            bucket_results = _bucketed_run(table, jobs)
+    for gi, group in enumerate(groups):
         attr, rows, currents = group.attr, group.rows, group.currents
         if group.empty_domain:
             if led is not None and len(rows):
@@ -85,7 +96,10 @@ def compute_domain_in_error_cells(
                        for r, cur in zip(rows, currents))
             continue
         vocab = table.column(attr).vocab
-        for lo, prob, contributed in group.score_chunks():
+        chunk_src = bucket_results.get(gi)
+        if chunk_src is None:
+            chunk_src = group.score_chunks()
+        for lo, prob, contributed in chunk_src:
             # One nonzero + lexsort over every surviving (cell, value) entry
             # instead of a per-cell scan: Python-level work is proportional to
             # the kept domain entries (few per cell), not cells x vocabulary.
@@ -124,36 +138,37 @@ class _AttrGroup:
     _ctx: Optional[tuple] = None
 
     def score_chunks(self):
-        """Yields (chunk offset, prob [cells, v_a], contributed) via the
-        (mesh-dispatching) scoring kernel, in DELPHI_DOMAIN_CHUNK_CELLS
-        chunks — the [cells, v_a] matrices are the phase's memory peak at
-        north-star scale, and a fixed chunk gives the mesh kernel a stable
-        shard shape."""
+        """LEGACY (``DELPHI_DEVICE_TABLE=0``) scoring: yields (chunk
+        offset, prob [cells, v_a], contributed) via the (mesh-dispatching)
+        scoring kernel, in DELPHI_DOMAIN_CHUNK_CELLS chunks — host
+        fancy-indexes each chunk's correlate codes and re-uploads them per
+        call. The device-resident default routes through the shape-bucketed
+        launcher (:func:`_bucketed_run`) instead."""
         assert self._ctx is not None
-        pair_tables, taus, corr_codes, has_single, n = self._ctx
+        pair_tables, taus, corr_cols, has_single, n = self._ctx
         chunk = _chunk_cells()
         operand_cache: dict = {}  # chunk-invariant device operands
         for lo in range(0, len(self.rows), chunk):
             sub_rows = self.rows[lo:lo + chunk]
-            codes_chunk = [c[sub_rows] for c in corr_codes]
+            codes_chunk = [c.codes[sub_rows] for c in corr_cols]
             prob, contributed = _score_cells(
                 codes_chunk, pair_tables, taus, has_single, n,
                 operand_cache=operand_cache)
             yield lo, prob, contributed
 
     def weak_label_chunks(self, vocab_rank: np.ndarray, beta: float):
-        """Yields (chunk offset, has_domain [cells], top value index
-        [cells]) through the FUSED device kernel — same chunking as
+        """LEGACY fused-kernel weak labeling: yields (chunk offset,
+        has_domain [cells], top value index [cells]) — same chunking as
         :meth:`score_chunks`, but only per-cell scalars return to the
-        host (the weak-label mask's dominant cost at north-star scale was
-        host passes over the [cells, v_a] matrices)."""
+        host. The device-resident default runs the same math through the
+        bucketed launcher's fused mode."""
         assert self._ctx is not None
-        pair_tables, taus, corr_codes, has_single, n = self._ctx
+        pair_tables, taus, corr_cols, has_single, n = self._ctx
         chunk = _chunk_cells()
         operand_cache: dict = {}
         for lo in range(0, len(self.rows), chunk):
             sub_rows = self.rows[lo:lo + chunk]
-            codes_chunk = [c[sub_rows] for c in corr_codes]
+            codes_chunk = [c.codes[sub_rows] for c in corr_cols]
             has_domain, top = _weak_label_chunk_device(
                 codes_chunk, pair_tables, taus, has_single, vocab_rank,
                 beta, n, operand_cache)
@@ -201,15 +216,19 @@ def _iter_attr_groups(disc: DiscretizedTable,
 
         single = freq.single(attr)[1:]  # [v_a], non-NULL value counts
         has_single = single > 0
-        pair_tables, taus, corr_codes = [], [], []
+        pair_tables, taus, corr_cols = [], [], []
         for c in corr_attrs:
             d_c = int(domain_stats[c])
             d_a = int(domain_stats[attr])
             taus.append(int(alpha * (n // max(d_c * d_a, 1))))
             pair_tables.append(freq.pair(c, attr))  # [V_c + 1, V_a + 1]
-            corr_codes.append(table.column(c).codes)
+            # the COLUMN OBJECT, not its codes: the device-resident plane
+            # caches uploaded code buffers per column identity (ops/xfer.py),
+            # and the same correlate column shared by several target
+            # attributes must hit that cache, not re-upload
+            corr_cols.append(table.column(c))
         yield _AttrGroup(attr, pos, rows, currents, empty_domain=False,
-                         _ctx=(pair_tables, taus, corr_codes, has_single, n))
+                         _ctx=(pair_tables, taus, corr_cols, has_single, n))
 
 
 def compute_weak_label_mask(
@@ -247,16 +266,16 @@ def compute_weak_label_mask(
     led = active_ledger()
     demote = np.zeros(len(cells[0]), dtype=bool)
 
-    for group in _iter_attr_groups(
-            disc, cells, continuous_attrs, target_attrs, freq,
-            pairwise_stats, domain_stats, max_attrs_to_compute_domains,
-            alpha):
+    groups = list(_iter_attr_groups(
+        disc, cells, continuous_attrs, target_attrs, freq,
+        pairwise_stats, domain_stats, max_attrs_to_compute_domains,
+        alpha))
+    # Per-group vocab rank machinery up front: the bucketed fused launches
+    # need every group's ranks before any post-processing runs.
+    ranks: Dict[int, tuple] = {}
+    for gi, group in enumerate(groups):
         if group.empty_domain:
-            if led is not None and len(group.rows):
-                led.record_domain_sizes(
-                    group.rows, group.attr,
-                    np.zeros(len(group.rows), dtype=np.int64))
-            continue  # empty domain -> never demoted
+            continue
         vocab = table.column(group.attr).vocab
         vocab_str = np.array([str(v) for v in vocab], dtype=object)
         # rank of each vocab slot in string sort order: the argmin below
@@ -265,34 +284,78 @@ def compute_weak_label_mask(
         order = np.argsort(vocab_str.astype(str), kind="stable")
         vocab_rank = np.empty(len(vocab), dtype=np.int64)
         vocab_rank[order] = np.arange(len(vocab))
+        ranks[gi] = (vocab_str, vocab_rank)
 
-        assert group._ctx is not None
-        pair_tables, taus, corr_codes, has_single, n = group._ctx
-        max_count = max((int(t.max(initial=0)) for t in pair_tables),
-                        default=0)
-        # Fused device path: scoring + beta mask + top-value pick run in one
-        # jitted program and only per-cell scalars come back — the dominant
-        # phase-1 cost at the 1e8-row north star was exactly these host
-        # passes over [cells, v_a] matrices. Same int32/float64 contract as
-        # the other routes (bit-identical demotions).
-        # the fused kernel returns only per-cell scalars, so the provenance
-        # ledger's per-cell domain sizes are unavailable on that route —
-        # ledger-enabled runs take the score_chunks path (an opt-in cost,
-        # like every other provenance hook)
-        fused = mesh is None and led is None \
-            and len(pair_tables) * max(max_count, 1) < 2 ** 31 \
-            and (len(group.rows) >= 65536
-                 or os.environ.get("DELPHI_DOMAIN_DEVICE") == "1")
-        if fused:
-            for lo, has_domain, top in group.weak_label_chunks(vocab_rank,
-                                                               beta):
-                eq = vocab_str[np.minimum(top, len(vocab) - 1)] \
+    # Device-resident default: int32-safe groups go through the bucketed
+    # batched launcher. The fused mode (per-cell scalars only, same gate as
+    # the legacy fused route: no ledger, big-or-forced) and the integer mode
+    # (full prob matrices for the ledger) can share launches' shape buckets.
+    plan: Dict[int, str] = {}
+    if _bucketed_enabled(table):
+        jobs = []
+        for gi, group in enumerate(groups):
+            if group.empty_domain or not _int32_safe_group(group):
+                continue
+            g_fused = led is None \
+                and (len(group.rows) >= 65536
+                     or os.environ.get("DELPHI_DOMAIN_DEVICE") == "1")
+            plan[gi] = "fused" if g_fused else "int"
+            jobs.append((gi, group, ranks[gi][1] if g_fused else None,
+                         g_fused))
+        bucket_results = _bucketed_run(table, jobs, beta=beta) if jobs \
+            else {}
+    else:
+        bucket_results = {}
+
+    for gi, group in enumerate(groups):
+        if group.empty_domain:
+            if led is not None and len(group.rows):
+                led.record_domain_sizes(
+                    group.rows, group.attr,
+                    np.zeros(len(group.rows), dtype=np.int64))
+            continue  # empty domain -> never demoted
+        vocab_str, vocab_rank = ranks[gi]
+
+        if plan.get(gi) == "fused":
+            for lo, has_domain, top in bucket_results[gi]:
+                eq = vocab_str[np.minimum(top, len(vocab_str) - 1)] \
                     == group.currents[lo:lo + len(top)]
                 demote[group.pos[lo:lo + len(top)]] = \
                     has_domain & eq.astype(bool)
             continue
 
-        for lo, prob, contributed in group.score_chunks():
+        if plan.get(gi) == "int":
+            chunk_src = bucket_results[gi]
+        else:
+            assert group._ctx is not None
+            pair_tables, taus, corr_cols, has_single, n = group._ctx
+            max_count = max((int(t.max(initial=0)) for t in pair_tables),
+                            default=0)
+            # Legacy fused device path (DELPHI_DEVICE_TABLE=0): scoring +
+            # beta mask + top-value pick run in one jitted program and only
+            # per-cell scalars come back — the dominant phase-1 cost at the
+            # 1e8-row north star was exactly these host passes over
+            # [cells, v_a] matrices. Same int32/float64 contract as the
+            # other routes (bit-identical demotions).
+            # the fused kernel returns only per-cell scalars, so the
+            # provenance ledger's per-cell domain sizes are unavailable on
+            # that route — ledger-enabled runs take the score_chunks path
+            # (an opt-in cost, like every other provenance hook)
+            fused = mesh is None and led is None \
+                and len(pair_tables) * max(max_count, 1) < 2 ** 31 \
+                and (len(group.rows) >= 65536
+                     or os.environ.get("DELPHI_DOMAIN_DEVICE") == "1")
+            if fused:
+                for lo, has_domain, top in group.weak_label_chunks(
+                        vocab_rank, beta):
+                    eq = vocab_str[np.minimum(top, len(vocab_str) - 1)] \
+                        == group.currents[lo:lo + len(top)]
+                    demote[group.pos[lo:lo + len(top)]] = \
+                        has_domain & eq.astype(bool)
+                continue
+            chunk_src = group.score_chunks()
+
+        for lo, prob, contributed in chunk_src:
             keep = contributed & (prob > beta)
             if led is not None and len(prob):
                 led.record_domain_sizes(group.rows[lo:lo + len(prob)],
@@ -366,16 +429,16 @@ def _pad_chunk_operands(codes_chunk, pair_tables, taus, has_single,
             tables[i, :t.shape[0], :t.shape[1]] = t
         hs = np.zeros(va_pad, bool)
         hs[:v_a] = np.asarray(has_single, bool)
-        operand_cache["tables"] = jnp.asarray(tables)
-        operand_cache["taus"] = jnp.asarray(
+        operand_cache["tables"] = to_device(tables)
+        operand_cache["taus"] = to_device(
             np.asarray([max(int(t), 0) for t in taus], np.int32))
-        operand_cache["hs"] = jnp.asarray(hs)
+        operand_cache["hs"] = to_device(hs)
         if vocab_rank is not None:
             # padded vocab slots: never active (hs False), and their rank
             # sits past every real rank so argmin cannot pick them
             rank = np.full(va_pad, np.iinfo(np.int32).max - 1, np.int32)
             rank[:v_a] = np.asarray(vocab_rank, np.int32)
-            operand_cache["rank"] = jnp.asarray(rank)
+            operand_cache["rank"] = to_device(rank)
 
     codes = np.full((k, n_pad), -1, np.int32)
     for i, c in enumerate(codes_chunk):
@@ -402,7 +465,7 @@ def _score_cells_device(codes_chunk, pair_tables, taus, has_single,
     codes, cells, v_a = _pad_chunk_operands(
         codes_chunk, pair_tables, taus, has_single, operand_cache)
     big, tiny, contributed = _score_kernel(
-        jnp.asarray(codes), operand_cache["tables"], operand_cache["taus"],
+        to_device(codes), operand_cache["tables"], operand_cache["taus"],
         operand_cache["hs"])
     return (np.asarray(big)[:cells, :v_a].astype(np.int64),
             np.asarray(tiny)[:cells, :v_a].astype(np.int64),
@@ -449,7 +512,7 @@ def _weak_label_chunk_device(codes_chunk, pair_tables, taus, has_single,
     (the [cells, v_a] probability matrices never materialize)."""
     global _weak_kernel
     import jax.numpy as jnp
-    from jax import enable_x64
+    from jax.experimental import enable_x64
 
     if _weak_kernel is None:
         _weak_kernel = _jit_weak_label_kernel()
@@ -458,7 +521,7 @@ def _weak_label_chunk_device(codes_chunk, pair_tables, taus, has_single,
             codes_chunk, pair_tables, taus, has_single, operand_cache,
             vocab_rank=vocab_rank)
         has_domain, top = _weak_kernel(
-            jnp.asarray(codes), operand_cache["tables"],
+            to_device(codes), operand_cache["tables"],
             operand_cache["taus"], operand_cache["hs"],
             operand_cache["rank"], float(beta), float(n_rows))
         return (np.asarray(has_domain)[:cells], np.asarray(top)[:cells])
@@ -527,3 +590,253 @@ def _combine_scores(big: np.ndarray, tiny: np.ndarray, contributed: np.ndarray,
     with np.errstate(divide="ignore", invalid="ignore"):
         prob = np.where(denom > 0, score / denom, 0.0)
     return prob, contributed
+
+
+# ---------------------------------------------------------------------------
+# Shape-bucketed batched scoring over the device-resident table
+# ---------------------------------------------------------------------------
+# The legacy chunk routes launch one program per (attribute group, chunk) and
+# re-upload each chunk's host-gathered correlate codes. With the table plane
+# on (DELPHI_DEVICE_TABLE, default), the encoded code matrix is already
+# resident, so scoring instead pads every (group, chunk) piece into a small
+# set of shape buckets keyed by (mode, k, va_pad, vc_pad, rows_pad) and runs
+# ONE vmapped launch per bucket: per phase the launch count is
+# O(shape buckets), not O(groups x chunks), and each launch moves a single
+# flat int32 operand blob instead of a codes matrix.
+
+_BUCKET_MIN_ROWS = 256
+# launch-size cap on the stacked pair tables (int32 elements, ~1 GiB): the
+# batched launch duplicates each piece's padded tables, so wide-vocab groups
+# batch fewer pieces per launch
+_BUCKET_TABLE_ELEMS = 1 << 28
+
+_bucket_kernel_int = None
+_bucket_kernel_fused = None
+
+
+def _bucketed_enabled(table) -> bool:
+    """Bucketed device-resident scoring runs single-process, mesh-off only:
+    mesh runs keep the row-sharded kernel (parallel/sharded.py) and
+    process-local shards keep their per-chunk route."""
+    from delphi_tpu.ops import xfer
+
+    if getattr(table, "process_local", False):
+        return False
+    if not xfer.device_table_enabled():
+        return False
+    from delphi_tpu.parallel.mesh import get_active_mesh
+    return get_active_mesh() is None
+
+
+def _int32_safe_group(group) -> bool:
+    # same 2^31 accumulator bound as _score_cells / the mesh kernel; unsafe
+    # groups fall back to the legacy (int64 host) chunk route
+    pair_tables = group._ctx[0]
+    max_count = max((int(t.max(initial=0)) for t in pair_tables), default=0)
+    return len(pair_tables) * max(max_count, 1) < 2 ** 31
+
+
+def _prep_group_operands(group, vocab_rank=None) -> dict:
+    """Host-side padded, chunk-invariant operands for one attribute group —
+    the SAME padding rules as _pad_chunk_operands, so the bucketed fused
+    kernel reduces over an identical va_pad axis to the legacy fused route
+    and the integer route's exact accumulators line up slot for slot."""
+    pair_tables, taus, corr_cols, has_single, n = group._ctx
+    k = len(corr_cols)
+    v_a = int(has_single.shape[0])
+    va_pad = -(-v_a // 32) * 32
+    vc_max = max(int(t.shape[0]) for t in pair_tables)
+    vc_pad = max(8, 1 << (vc_max - 1).bit_length())
+    tables = np.zeros((k, vc_pad, va_pad + 1), np.int32)
+    for i, t in enumerate(pair_tables):
+        tables[i, :t.shape[0], :t.shape[1]] = t
+    hs = np.zeros(va_pad, np.int32)
+    hs[:v_a] = np.asarray(has_single, bool)
+    rank = None
+    if vocab_rank is not None:
+        rank = np.full(va_pad, np.iinfo(np.int32).max - 1, np.int32)
+        rank[:v_a] = np.asarray(vocab_rank, np.int32)
+    return dict(k=k, v_a=v_a, va_pad=va_pad, vc_pad=vc_pad, n=n,
+                tables=tables,
+                taus=np.asarray([max(int(t), 0) for t in taus], np.int32),
+                hs=hs, rank=rank)
+
+
+def _jit_bucket_kernel(fused: bool):
+    """One jitted program per bucket shape x mode: a vmap over the pieces
+    packed into the launch. Every per-piece operand arrives in ONE flat
+    int32 blob (a single host->device transfer) carved up with static
+    offsets inside the trace; row subsets are device-side gathers from the
+    resident code matrix instead of host fancy-indexing + re-upload."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+    def kernel(blob, all_codes, b, k, va_pad, vc_pad, rows_pad,
+               beta, n_rows):
+        offs = [0]
+
+        def take(*shape):
+            size = 1
+            for d in shape:
+                size *= d
+            out = blob[offs[0]:offs[0] + size].reshape(shape)
+            offs[0] += size
+            return out
+
+        col_idx = take(b, k)
+        taus = take(b, k)
+        hs = take(b, va_pad).astype(bool)
+        rank = take(b, va_pad) if fused else None
+        row_idx = take(b, rows_pad)
+        tables = take(b, k, vc_pad, va_pad + 1)
+
+        def piece(ci, ri, tb, ta, h):
+            # [k, rows_pad] in one gather: the piece's correlate columns x
+            # its row subset; padded row indices hit the sentinel row of
+            # NULL codes and can never activate
+            codes = all_codes[ci[:, None], ri[None, :]]
+            return _int_score_body(codes, tb, ta, h)
+
+        if not fused:
+            return jax.vmap(piece)(col_idx, row_idx, tables, taus, hs)
+
+        def piece_fused(ci, ri, tb, ta, h, rk):
+            big, tiny, contributed = piece(ci, ri, tb, ta, h)
+            # identical float64 recombination + rank tie-break as
+            # _jit_weak_label_kernel (same ulp caveat vs the numpy route)
+            score = big.astype(jnp.float64) + 0.1 * tiny.astype(jnp.float64)
+            score = score / n_rows
+            denom = score.sum(axis=1, keepdims=True)
+            prob = jnp.where(denom > 0, score / denom, 0.0)
+            masked = jnp.where(contributed & (prob > beta), prob, -jnp.inf)
+            best = masked.max(axis=1)
+            has_domain = best > -jnp.inf
+            ties = masked == best[:, None]
+            rank_masked = jnp.where(ties, rk[None, :],
+                                    jnp.iinfo(jnp.int32).max)
+            top = jnp.argmin(rank_masked, axis=1).astype(jnp.int32)
+            return has_domain, top
+
+        return jax.vmap(piece_fused)(col_idx, row_idx, tables, taus, hs,
+                                     rank)
+
+    return kernel
+
+
+def _bucketed_run(table, jobs, beta=None):
+    """Runs every (group, chunk) piece of ``jobs`` through shape-bucketed
+    batched launches against the device-resident code matrix.
+
+    ``jobs``: (gi, group, vocab_rank_or_None, fused) tuples. Integer-mode
+    results are host-recombined through _combine_scores (bit-identical to
+    the legacy routes); fused-mode results are the weak-label scalars.
+    Returns {gi: [(lo, ...), ...]} sorted by chunk offset."""
+    import jax.numpy as jnp
+
+    from delphi_tpu.ops import xfer
+
+    # distinct correlate columns across every job, first-use order; the
+    # stacked matrix gets one trailing sentinel row of NULL codes so padded
+    # row indices gather an always-inactive cell
+    col_slot: Dict[int, int] = {}
+    cols = []
+    for _, g, _, _ in jobs:
+        for c in g._ctx[2]:
+            if id(c) not in col_slot:
+                col_slot[id(c)] = len(cols)
+                cols.append(c)
+    base = jnp.stack([xfer.device_codes(c) for c in cols])
+    all_codes = jnp.pad(base, ((0, 0), (0, 1)), constant_values=NULL_CODE)
+    sentinel = int(base.shape[1])
+
+    chunk = _chunk_cells()
+    out = {j[0]: [] for j in jobs}
+    buckets: Dict[tuple, list] = {}
+    for gi, g, rank, fused in jobs:
+        prep = _prep_group_operands(g, rank)
+        cidx = np.asarray([col_slot[id(c)] for c in g._ctx[2]], np.int32)
+        for lo in range(0, len(g.rows), chunk):
+            sub = np.asarray(g.rows[lo:lo + chunk], np.int64)
+            rows_pad = max(_BUCKET_MIN_ROWS,
+                           1 << max(len(sub) - 1, 0).bit_length())
+            key = (fused, prep["k"], prep["va_pad"], prep["vc_pad"],
+                   rows_pad)
+            buckets.setdefault(key, []).append((gi, lo, sub, prep, cidx))
+
+    for (fused, k, va_pad, vc_pad, rows_pad), pieces in buckets.items():
+        # launch budget: cells bounded by the legacy chunk size, table
+        # duplication bounded separately (wide-vocab groups)
+        per_tables = k * vc_pad * (va_pad + 1)
+        b_max = max(1, min(chunk // max(rows_pad, 1),
+                           _BUCKET_TABLE_ELEMS // max(per_tables, 1)))
+        for s in range(0, len(pieces), b_max):
+            _launch_bucket(pieces[s:s + b_max], fused, k, va_pad, vc_pad,
+                           rows_pad, all_codes, sentinel, beta, out)
+    for gi in out:
+        out[gi].sort(key=lambda t: t[0])
+    return out
+
+
+def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, all_codes,
+                   sentinel, beta, out):
+    global _bucket_kernel_int, _bucket_kernel_fused
+    b = len(batch)
+    b_pad = 1 << (b - 1).bit_length()
+    col_idx = np.zeros((b_pad, k), np.int32)
+    taus = np.zeros((b_pad, k), np.int32)
+    hs = np.zeros((b_pad, va_pad), np.int32)
+    rank = np.full((b_pad, va_pad), np.iinfo(np.int32).max - 1, np.int32) \
+        if fused else None
+    row_idx = np.full((b_pad, rows_pad), sentinel, np.int32)
+    tables = np.zeros((b_pad, k, vc_pad, va_pad + 1), np.int32)
+    n_rows = 1.0  # every piece shares freq.n_rows (global row count)
+    for i, (gi, lo, sub, prep, cidx) in enumerate(batch):
+        col_idx[i] = cidx
+        taus[i] = prep["taus"]
+        hs[i] = prep["hs"]
+        if fused:
+            rank[i] = prep["rank"]
+        row_idx[i, :len(sub)] = sub
+        tables[i] = prep["tables"]
+        n_rows = float(prep["n"])
+    parts = [col_idx.ravel(), taus.ravel(), hs.ravel()]
+    if fused:
+        parts.append(rank.ravel())
+    parts += [row_idx.ravel(), tables.ravel()]
+    blob_np = np.concatenate(parts)
+
+    counter_inc("domain.bucket_launches")
+    counter_inc("domain.bucket_pieces", b)
+
+    if fused:
+        from jax.experimental import enable_x64
+        if _bucket_kernel_fused is None:
+            _bucket_kernel_fused = _jit_bucket_kernel(True)
+        with enable_x64():
+            has_domain, top = _bucket_kernel_fused(
+                to_device(blob_np), all_codes, b_pad, k, va_pad, vc_pad,
+                rows_pad, float(beta), n_rows)
+        has_domain = np.asarray(has_domain)
+        top = np.asarray(top)
+        for i, (gi, lo, sub, prep, cidx) in enumerate(batch):
+            m = len(sub)
+            out[gi].append((lo, has_domain[i, :m], top[i, :m]))
+        return
+
+    if _bucket_kernel_int is None:
+        _bucket_kernel_int = _jit_bucket_kernel(False)
+    big, tiny, contributed = _bucket_kernel_int(
+        to_device(blob_np), all_codes, b_pad, k, va_pad, vc_pad, rows_pad,
+        0.0, 1.0)
+    big = np.asarray(big)
+    tiny = np.asarray(tiny)
+    contributed = np.asarray(contributed)
+    for i, (gi, lo, sub, prep, cidx) in enumerate(batch):
+        m, v_a = len(sub), prep["v_a"]
+        prob, contrib = _combine_scores(
+            big[i, :m, :v_a].astype(np.int64),
+            tiny[i, :m, :v_a].astype(np.int64),
+            contributed[i, :m, :v_a], prep["n"])
+        out[gi].append((lo, prob, contrib))

@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence, Tuple
 import jax
 import numpy as np
 
-from delphi_tpu.ops.freq import FreqStats, Pair, _pallas_policy
+from delphi_tpu.ops.freq import FreqStats, Pair
 
 # Below this many count groups the f64 host reduction wins; above it the
 # single-pass VPU kernel (ops/pallas_kernels.py) avoids pulling big pair
@@ -33,17 +33,14 @@ _PALLAS_ENTROPY_MIN_GROUPS = 1 << 16
 
 
 def _use_pallas_entropy(n_groups: int, n_rows: int) -> bool:
-    from delphi_tpu.ops.pallas_kernels import entropy_pallas_supported
+    # policy parsing + capability fold shared with the pair-count routing
+    # (ops/pallas_kernels.resolve_pallas_policy) so the two cannot drift
+    from delphi_tpu.ops import pallas_kernels as pk
 
-    policy = _pallas_policy()
-    if policy in ("0", "off", "never"):
-        return False
-    if not entropy_pallas_supported(n_groups, n_rows):
-        return False
-    if policy in ("1", "on", "force"):
-        return True
-    return jax.default_backend() == "tpu" and \
-        n_groups >= _PALLAS_ENTROPY_MIN_GROUPS
+    return pk.resolve_pallas_policy(
+        pk.entropy_pallas_supported(n_groups, n_rows),
+        default=jax.default_backend() == "tpu"
+        and n_groups >= _PALLAS_ENTROPY_MIN_GROUPS)
 
 
 def _entropy_with_correction(counts: np.ndarray, n_rows: int, ub_domain: int) \

@@ -38,27 +38,38 @@ _lower_bound_logged = False
 Pair = Tuple[str, str]
 
 # Memory budget for batched pair-stat launches: caps the [pairs, rows]
-# fused-key / code buffers at ~1 GB per launch (int32/64 elements).
+# fused-key / code buffers at ~1 GB per launch (int32/64 elements). The
+# default; runtime reads go through _pair_keys_per_launch so the budget is
+# tunable per deployment (DELPHI_PAIR_BUDGET / repair.pair.budget).
 _PAIR_KEYS_PER_LAUNCH = 2.5e8
 
+# Back-compat alias: the policy parser moved to ops/pallas_kernels (shared
+# with the entropy kernel routing) — see pallas_policy there.
+from delphi_tpu.ops.pallas_kernels import pallas_policy as _pallas_policy  # noqa: E402,F401
 
-def _pallas_policy() -> str:
-    """DELPHI_PALLAS=1 forces the pallas kernels (interpret mode off-TPU),
-    0 disables them, auto (default) uses them only on a real TPU backend."""
-    return os.environ.get("DELPHI_PALLAS", "auto").lower()
+
+def _pair_keys_per_launch() -> float:
+    """The [pairs, rows] element budget per batched pair-stat launch.
+    ``DELPHI_PAIR_BUDGET`` (env) wins over the ``repair.pair.budget``
+    session config; both fall back to the module default
+    ``_PAIR_KEYS_PER_LAUNCH`` (which tests may monkeypatch)."""
+    env = os.environ.get("DELPHI_PAIR_BUDGET")
+    if env:
+        return float(env)
+    from delphi_tpu.session import get_session
+
+    conf = get_session().conf.get("repair.pair.budget")
+    if conf:
+        return float(conf)
+    return float(_PAIR_KEYS_PER_LAUNCH)
 
 
 def use_pallas_pair_counts(vx: int, vy: int, n_rows: int = 0) -> bool:
     from delphi_tpu.ops import pallas_kernels as pk
 
-    policy = _pallas_policy()
-    if policy in ("0", "off", "never"):
-        return False
-    if not pk.pallas_supported(vx, vy, n_rows):
-        return False
-    if policy in ("1", "on", "force"):
-        return True
-    return jax.default_backend() == "tpu"
+    return pk.resolve_pallas_policy(
+        pk.pallas_supported(vx, vy, n_rows),
+        default=jax.default_backend() == "tpu")
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -152,7 +163,6 @@ def compute_freq_stats(table: EncodedTable,
     needed = list(dict.fromkeys(attrs + [a for p in pairs for a in p]))
     v_pad = max((vocab_sizes[a] for a in needed), default=0)
 
-    codes_np = table.codes(needed)
     name_to_idx = {a: i for i, a in enumerate(needed)}
 
     # Process-local table (sharded ingestion): every process holds only its
@@ -168,7 +178,7 @@ def compute_freq_stats(table: EncodedTable,
             sharded_pair_counts_global, sharded_single_counts_global)
 
         pl_mesh = make_mesh()
-        garr = shard_rows_process_local(codes_np, pl_mesh, fill=-2)
+        garr = shard_rows_process_local(table.codes(needed), pl_mesh, fill=-2)
         singles_arr = sharded_single_counts_global(garr, v_pad, pl_mesh)
         singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1]
                    for a in needed}
@@ -196,6 +206,7 @@ def compute_freq_stats(table: EncodedTable,
         from delphi_tpu.parallel.sharded import (
             sharded_pair_counts, sharded_single_counts)
 
+        codes_np = table.codes(needed)
         singles_arr = sharded_single_counts(codes_np, v_pad, mesh)
         singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1]
                    for a in needed}
@@ -212,7 +223,18 @@ def compute_freq_stats(table: EncodedTable,
             singles=singles, pairs=pair_mats,
             threshold_count=int(table.n_rows * attr_freq_ratio_threshold))
 
-    codes = jnp.asarray(codes_np)
+    # Single-device path: with the device-resident table plane on (the
+    # default), each needed column uploads ONCE through the cached seam and
+    # the [n, m] working matrix is a device-side stack — later phases
+    # (domain scoring gathers, distinct-pair warms) reuse the same buffers
+    # with zero additional transfer. DELPHI_DEVICE_TABLE=0 keeps the legacy
+    # upload-the-stacked-matrix-per-call behavior for A/B benchmarking.
+    from delphi_tpu.ops import xfer
+    if xfer.device_table_enabled():
+        codes = jnp.stack(
+            [xfer.device_codes(table.column(a)) for a in needed], axis=1)
+    else:
+        codes = xfer.to_device(table.codes(needed))
     singles_arr = np.asarray(_batched_single_counts(codes, v_pad))
     singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
 
@@ -238,14 +260,15 @@ def compute_freq_stats(table: EncodedTable,
         # The vmapped kernel materializes a [pairs, rows] fused-key buffer;
         # bound it to ~1 GB per launch so 10M+-row tables don't blow device
         # memory when many candidate pairs arrive at once.
-        per_launch = max(1, int(_PAIR_KEYS_PER_LAUNCH // max(table.n_rows, 1)))
+        per_launch = max(1,
+                         int(_pair_keys_per_launch() // max(table.n_rows, 1)))
         for s in range(0, len(xla_pairs), per_launch):
             group = xla_pairs[s:s + per_launch]
-            xi = jnp.asarray([name_to_idx[x] for x, _ in group],
-                             dtype=jnp.int32)
-            yi = jnp.asarray([name_to_idx[y] for _, y in group],
-                             dtype=jnp.int32)
-            flat = np.asarray(_batched_pair_counts(codes, xi, yi, v_pad))
+            # one [2, P] upload instead of two separate index vectors
+            xy = xfer.to_device(np.asarray(
+                [[name_to_idx[x] for x, _ in group],
+                 [name_to_idx[y] for _, y in group]], dtype=np.int32))
+            flat = np.asarray(_batched_pair_counts(codes, xy[0], xy[1], v_pad))
             for p, (x, y) in enumerate(group):
                 m = flat[p].reshape(stride, stride)
                 pair_mats[(x, y)] = \
@@ -361,20 +384,29 @@ class PairDistinctCounter:
             return
         # Bound the [chunk, rows] code stacks (x2 attrs + lexsort workspace)
         # to ~1 GB regardless of table size.
+        from delphi_tpu.ops import xfer
         chunk_size = max(1, min(self._WARM_CHUNK,
-                                int(_PAIR_KEYS_PER_LAUNCH
+                                int(_pair_keys_per_launch()
                                     // self._table.n_rows)))
+        resident = xfer.device_table_enabled()
         local_counts = []
         for s in range(0, len(todo), chunk_size):
             chunk = todo[s:s + chunk_size]
             # pad short chunks by repeating the last pair so every launch
             # shares one compiled (batch) shape; duplicates are discarded
             padded = chunk + [chunk[-1]] * (chunk_size - len(chunk))
-            c1 = np.stack([self._table.column(x).codes for x, _ in padded])
-            c2 = np.stack([self._table.column(y).codes for _, y in padded])
-            counts = np.asarray(
-                _batched_distinct_pair_counts(jnp.asarray(c1),
-                                              jnp.asarray(c2)))
+            if resident:
+                # device-side stacks over the once-uploaded column buffers
+                c1 = jnp.stack([xfer.device_codes(self._table.column(x))
+                                for x, _ in padded])
+                c2 = jnp.stack([xfer.device_codes(self._table.column(y))
+                                for _, y in padded])
+            else:
+                c1 = xfer.to_device(np.stack(
+                    [self._table.column(x).codes for x, _ in padded]))
+                c2 = xfer.to_device(np.stack(
+                    [self._table.column(y).codes for _, y in padded]))
+            counts = np.asarray(_batched_distinct_pair_counts(c1, c2))
             local_counts.extend(int(c) for c in counts[:len(chunk)])
         for (x, y), c in zip(todo, self._merge_global_many(local_counts)):
             self._cache[frozenset((x, y))] = c

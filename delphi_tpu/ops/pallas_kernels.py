@@ -25,6 +25,7 @@ Kernels run compiled on TPU and in interpret mode on CPU (tests exercise both
 paths against the XLA reference implementation).
 """
 
+import os
 from functools import partial
 from typing import Tuple
 
@@ -34,6 +35,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from delphi_tpu.ops.xfer import to_device
+
 _ROW_TILE = 4096         # rows contracted per grid step
 _LANE = 128              # TPU lane width; vocab padded to a multiple
 _PAD_SENTINEL = -2       # shifted to -1: matches no one-hot column
@@ -42,6 +45,27 @@ _VMEM_V_LIMIT = 2048     # fall back to XLA above this padded vocab size
 
 def _interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def pallas_policy() -> str:
+    """DELPHI_PALLAS=1 forces the pallas kernels (interpret mode off-TPU),
+    0 disables them, auto (default) leaves the decision to the caller's
+    ``default`` (normally: only on a real TPU backend). The ONE policy
+    parser shared by every pallas routing decision (pair counts in
+    ops/freq.py, entropy terms in ops/entropy.py)."""
+    return os.environ.get("DELPHI_PALLAS", "auto").lower()
+
+
+def resolve_pallas_policy(supported: bool, default: bool) -> bool:
+    """Folds the DELPHI_PALLAS policy with a kernel's capability guard:
+    never run an unsupported shape, always honor an explicit 0/1, and fall
+    back to the caller's backend-dependent ``default`` on auto."""
+    policy = pallas_policy()
+    if policy in ("0", "off", "never") or not supported:
+        return False
+    if policy in ("1", "on", "force"):
+        return True
+    return default
 
 
 def _round_up(x: int, m: int) -> int:
@@ -124,7 +148,7 @@ def pallas_pair_counts(x_codes: np.ndarray, y_codes: np.ndarray,
     (f32 accumulation is exact below 2^24 rows per shard)."""
     vx_pad = _round_up(vx + 1, _LANE)
     vy_pad = _round_up(vy + 1, _LANE)
-    counts = _pair_counts_padded(jnp.asarray(x_codes), jnp.asarray(y_codes),
+    counts = _pair_counts_padded(to_device(x_codes), to_device(y_codes),
                                  vx_pad, vy_pad, _interpret_mode())
     return np.asarray(counts)[: vx + 1, : vy + 1]
 
@@ -199,7 +223,7 @@ def pallas_entropy_terms(counts: np.ndarray, n_rows: int) \
     buf[0, : flat.size] = flat
 
     out = np.asarray(_entropy_call(
-        jnp.asarray(buf),
-        jnp.asarray([[float(n_rows)]], dtype=jnp.float32),
+        to_device(buf),
+        to_device(np.asarray([[float(n_rows)]], dtype=np.float32)),
         _interpret_mode()))
     return float(out[0, 0]), float(out[0, 1]), int(out[0, 2])

@@ -1,0 +1,110 @@
+"""Host→device upload seam + transfer ledger + device-resident column cache.
+
+Every host→device upload in :mod:`delphi_tpu.ops` funnels through
+:func:`to_device` — the ONE allowlisted call site for ``jnp.asarray`` /
+``device_put`` in the ops layer (``tests/test_transfer_guard.py`` greps for
+strays). Centralizing the seam buys two things:
+
+* a **transfer ledger**: every upload records ``transfer.bytes`` /
+  ``transfer.calls`` plus per-phase attribution counters
+  (``transfer.phase.<phase>.bytes|calls``) into the active run recorder's
+  metrics registry, so the run report and the live ``/metrics`` endpoint
+  show exactly how much host↔device chatter each phase caused — and
+  ``bench.py --smoke`` can assert the device-resident path moves strictly
+  less than the legacy one;
+* the **device-resident table plane** (``DELPHI_DEVICE_TABLE`` /
+  ``repair.device_table``, default on): :func:`device_codes` uploads an
+  encoded column's code vector once and caches the device buffer on the
+  column OBJECT. ``with_updates`` / ``with_nulls_at_arrays`` /
+  ``discretize_table`` replace changed columns via ``dataclasses.replace``
+  (fresh objects) and keep unchanged ones, so cache invalidation is object
+  identity — a mutated column can never serve a stale device buffer, and an
+  untouched column keeps its buffer across every phase and table copy.
+"""
+
+import os
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+from delphi_tpu.observability import counter_inc
+from delphi_tpu.observability.spans import current_recorder
+
+_FALSY = frozenset({"0", "false", "no", "off"})
+
+# Attribute slot used to cache a column's device-resident codes. Plain
+# attribute on the (non-slots) EncodedColumn dataclass: dataclasses.replace
+# copies declared fields only, so replaced columns start cold by design.
+_DEVICE_CODES_ATTR = "_delphi_device_codes"
+
+_PHASE_SAN = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def device_table_enabled() -> bool:
+    """True when the device-resident table plane is on (the default).
+    ``DELPHI_DEVICE_TABLE`` wins over the ``repair.device_table`` session
+    config; ``0``/``false``/``no``/``off`` disable — the legacy
+    upload-per-call behavior kept for A/B benchmarking."""
+    env = os.environ.get("DELPHI_DEVICE_TABLE")
+    if env is not None:
+        return env.strip().lower() not in _FALSY
+    from delphi_tpu.session import get_session
+
+    conf = get_session().conf.get("repair.device_table")
+    if conf is not None:
+        return str(conf).strip().lower() not in _FALSY
+    return True
+
+
+def record_transfer(nbytes: int, calls: int = 1) -> None:
+    """Ledger entry for one host→device upload: global totals plus
+    per-phase attribution keyed by the recorder's current span name. No-ops
+    (single predicate check inside counter_inc) when no run recorder is
+    active."""
+    counter_inc("transfer.calls", calls)
+    counter_inc("transfer.bytes", int(nbytes))
+    rec = current_recorder()
+    if rec is not None:
+        phase = _PHASE_SAN.sub("_", str(rec.current_phase))
+        counter_inc(f"transfer.phase.{phase}.calls", calls)
+        counter_inc(f"transfer.phase.{phase}.bytes", int(nbytes))
+
+
+def to_device(x: Any, dtype: Any = None):
+    """The ops layer's single host→device upload point: converts ``x`` to a
+    device array via ``jnp.asarray`` and records the moved bytes in the
+    transfer ledger. Arrays already on device pass through uncounted (and
+    bump ``transfer.reuses`` so reuse is visible too). Honors an enclosing
+    ``enable_x64`` context exactly like a direct ``jnp.asarray`` call."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(x, jax.Array):
+        counter_inc("transfer.reuses")
+        return x if dtype is None else x.astype(dtype)
+    arr = np.asarray(x) if dtype is None else np.asarray(x, dtype=dtype)
+    record_transfer(arr.nbytes)
+    return jnp.asarray(arr)
+
+
+def device_codes(col):
+    """Device-resident int32 codes for one :class:`~delphi_tpu.table.
+    EncodedColumn` — uploaded once per column object, then served from the
+    on-object cache (``transfer.reuses`` counts the hits). With the plane
+    disabled (``DELPHI_DEVICE_TABLE=0``) every call re-uploads, which is
+    the legacy behavior the transfer ledger benchmarks against."""
+    if not device_table_enabled():
+        return to_device(col.codes)
+    cached = getattr(col, _DEVICE_CODES_ATTR, None)
+    if cached is not None:
+        counter_inc("transfer.reuses")
+        return cached
+    arr = to_device(col.codes)
+    setattr(col, _DEVICE_CODES_ATTR, arr)
+    return arr
+
+
+def cached_device_codes(col) -> Optional[Any]:
+    """The column's cached device buffer, or ``None`` when cold (tests)."""
+    return getattr(col, _DEVICE_CODES_ATTR, None)

@@ -186,6 +186,10 @@ def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
     contributing rows to shards they don't hold)."""
     spec = P(axis, *([None] * (array.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
+    # transfer ledger (ops/xfer.py): sharded placement is still a
+    # host->device upload and must show up in the same accounting
+    from delphi_tpu.ops.xfer import record_transfer
+    record_transfer(array.nbytes)
     if jax.process_count() > 1:
         return jax.make_array_from_callback(
             array.shape, sharding,
@@ -220,6 +224,8 @@ def shard_rows_process_local(local_rows: np.ndarray, mesh: Mesh,
     spec = P(axis, *([None] * (local_rows.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
     global_shape = (per * jax.process_count(),) + local_rows.shape[1:]
+    from delphi_tpu.ops.xfer import record_transfer
+    record_transfer(padded.nbytes)  # this process's contributed block
     return jax.make_array_from_process_local_data(sharding, padded, global_shape)
 
 

@@ -59,8 +59,11 @@ def sharded_pair_counts_global(global_codes, pairs: Sequence[Tuple[int, int]],
     entry point for sharded ingestion, where each process contributed its
     own rows via `shard_rows_process_local` (padding rows = -2) and no host
     ever saw the full table."""
-    xi = jnp.asarray([p[0] for p in pairs], dtype=jnp.int32)
-    yi = jnp.asarray([p[1] for p in pairs], dtype=jnp.int32)
+    from delphi_tpu.ops.xfer import to_device
+    # one packed [2, P] upload instead of two tiny ones (transfer ledger)
+    xy = to_device(np.asarray([[p[0] for p in pairs],
+                               [p[1] for p in pairs]], dtype=np.int32))
+    xi, yi = xy[0], xy[1]
     stride = v_pad + 1
 
     @partial(shard_map, mesh=mesh,
@@ -134,9 +137,10 @@ def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],
                         for o in out)
         return out
 
+    from delphi_tpu.ops.xfer import to_device
     big, tiny, contributed = kernel(
-        shard_rows(padded, mesh), jnp.asarray(tables), jnp.asarray(taus_arr),
-        jnp.asarray(hs))
+        shard_rows(padded, mesh), to_device(tables), to_device(taus_arr),
+        to_device(hs))
     return (np.asarray(big)[:cells], np.asarray(tiny)[:cells],
             np.asarray(contributed)[:cells])
 

@@ -1,0 +1,227 @@
+"""Device-resident table plane (ops/xfer.py + the bucketed batched scorer
+in ops/domain.py): on/off repair parity, bucket-boundary correctness,
+transfer-ledger counters, and the O(shape-buckets) launch contract."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import delphi_tpu.observability as obs
+
+
+def _tiny_dirty_frame() -> pd.DataFrame:
+    n = 48
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    })
+    df.loc[df.index % 9 == 0, "c1"] = None
+    return df
+
+
+def _repair(session, name: str) -> pd.DataFrame:
+    from delphi_tpu import NullErrorDetector, delphi
+    session.register(name, _tiny_dirty_frame())
+    out = delphi.repair \
+        .setTableName(name) \
+        .setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .run()
+    return out.sort_values(list(out.columns)).reset_index(drop=True)
+
+
+def test_repair_bit_identical_with_device_table_on_and_off(session,
+                                                           monkeypatch):
+    """The full pipeline must produce byte-for-byte the same repairs with
+    the device-resident plane on (bucketed batched scoring) and off (legacy
+    per-chunk upload)."""
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "0")
+    off = _repair(session, "devtab_off")
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    on = _repair(session, "devtab_on")
+    pd.testing.assert_frame_equal(off, on)
+
+
+def _scoring_fixture(n_cells: int, seed: int = 3):
+    """A synthetic table plus `n_cells` error cells on one target attribute
+    — sized to land exactly on / next to the bucketed launcher's row-pad
+    edges (256 is _BUCKET_MIN_ROWS)."""
+    from delphi_tpu.ops.entropy import compute_pairwise_stats
+    from delphi_tpu.ops.freq import compute_freq_stats
+    from delphi_tpu.table import discretize_table, encode_table
+
+    rng = np.random.RandomState(seed)
+    n = max(600, n_cells + 10)
+    base = rng.randint(0, 6, n)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "a": np.array([f"A{v}" for v in base], dtype=object),
+        "b": np.array(
+            [f"B{v}" for v in (base + rng.binomial(1, 0.1, n)) % 6],
+            dtype=object),
+        "c": np.array([f"C{v}" for v in rng.randint(0, 4, n)], dtype=object),
+    })
+    table = encode_table(df, "tid")
+    disc = discretize_table(table, 80)
+    attrs = disc.table.column_names
+    pairs = [(x, y) for x in attrs for y in attrs if x != y]
+    freq = compute_freq_stats(disc.table, attrs, pairs, 0.0)
+    pairwise = compute_pairwise_stats(n, freq, pairs, disc.domain_stats)
+    for t in attrs:
+        pairwise.setdefault(t, [])
+
+    rows = rng.choice(n, n_cells, replace=False).astype(np.int64)
+    cells_attrs = np.array(["a"] * n_cells, dtype=object)
+    currents = np.array([str(df.at[int(r), "a"]) for r in rows], dtype=object)
+    cells = (rows, cells_attrs, currents)
+    return (disc, cells, [], attrs, freq, pairwise, disc.domain_stats,
+            4, 0.0, 0.1)
+
+
+@pytest.mark.parametrize("n_cells", [255, 256, 257])
+def test_bucketed_scoring_matches_legacy_at_bucket_boundaries(
+        monkeypatch, n_cells):
+    """Cell counts exactly at and one past a row-pad edge must score
+    bit-identically with the plane on (bucketed) and off (legacy)."""
+    from delphi_tpu.ops.domain import (
+        compute_domain_in_error_cells, compute_weak_label_mask)
+
+    args = _scoring_fixture(n_cells)
+
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "0")
+    doms_off = compute_domain_in_error_cells(*args)
+    mask_off = compute_weak_label_mask(*args)
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    doms_on = compute_domain_in_error_cells(*args)
+    mask_on = compute_weak_label_mask(*args)
+
+    assert (mask_on == mask_off).all()
+    assert len(doms_on) == len(doms_off) == n_cells
+    for d_on, d_off in zip(doms_on, doms_off):
+        assert (d_on.row_index, d_on.attribute, d_on.current_value) \
+            == (d_off.row_index, d_off.attribute, d_off.current_value)
+        assert d_on.domain == d_off.domain  # exact float equality
+
+
+def test_bucketed_fused_matches_legacy_fused(monkeypatch):
+    """The bucketed launcher's fused mode (DELPHI_DOMAIN_DEVICE=1 forces it
+    below the size threshold) must demote the same cells as the legacy
+    fused kernel."""
+    from delphi_tpu.ops.domain import compute_weak_label_mask
+
+    args = _scoring_fixture(300, seed=11)
+    monkeypatch.setenv("DELPHI_DOMAIN_DEVICE", "1")
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "0")
+    legacy = compute_weak_label_mask(*args)
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    bucketed = compute_weak_label_mask(*args)
+    assert legacy.any()
+    assert (bucketed == legacy).all()
+
+
+def test_bucketed_launch_count_is_per_bucket_not_per_group(monkeypatch):
+    """Two attribute groups whose padded shapes coincide must share ONE
+    batched launch — the launch count is O(shape buckets), not
+    O(groups x chunks)."""
+    from delphi_tpu.ops.domain import compute_domain_in_error_cells
+    from delphi_tpu.ops.entropy import compute_pairwise_stats
+    from delphi_tpu.ops.freq import compute_freq_stats
+    from delphi_tpu.table import discretize_table, encode_table
+
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    rng = np.random.RandomState(7)
+    n = 300
+    base = rng.randint(0, 5, n)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        # a and b: same vocab size -> same (k, va_pad, vc_pad) bucket
+        "a": np.array([f"A{v}" for v in base], dtype=object),
+        "b": np.array([f"B{v}" for v in (base + 1) % 5], dtype=object),
+        "c": np.array([f"C{v}" for v in (base + 2) % 5], dtype=object),
+    })
+    table = encode_table(df, "tid")
+    disc = discretize_table(table, 80)
+    attrs = disc.table.column_names
+    pairs = [(x, y) for x in attrs for y in attrs if x != y]
+    freq = compute_freq_stats(disc.table, attrs, pairs, 0.0)
+    pairwise = compute_pairwise_stats(n, freq, pairs, disc.domain_stats)
+    for t in attrs:
+        pairwise.setdefault(t, [])
+
+    rows = np.arange(60, dtype=np.int64)
+    cells = (np.concatenate([rows, rows]),
+             np.array(["a"] * 60 + ["b"] * 60, dtype=object),
+             np.array([str(df.at[int(r), a]) for r, a in
+                       zip(np.concatenate([rows, rows]),
+                           ["a"] * 60 + ["b"] * 60)], dtype=object))
+
+    rec = obs.start_recording("test.bucketed.launches")
+    try:
+        doms = compute_domain_in_error_cells(
+            disc, cells, [], attrs, freq, pairwise, disc.domain_stats,
+            4, 0.0, 0.1)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+
+    assert len(doms) == 120
+    assert counters.get("domain.bucket_pieces", 0) == 2  # one per group
+    assert counters.get("domain.bucket_launches", 0) == 1  # shared bucket
+
+
+def test_transfer_ledger_counters(session, monkeypatch):
+    """A full repair with the plane on must record transfer totals,
+    per-phase attribution, cache reuses, and the device-table gauge."""
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    rec = obs.start_recording("test.transfer.ledger")
+    try:
+        _repair(session, "devtab_ledger")
+        snap = rec.registry.snapshot()
+    finally:
+        obs.stop_recording(rec)
+    counters, gauges = snap["counters"], snap["gauges"]
+    assert counters.get("transfer.calls", 0) > 0
+    assert counters.get("transfer.bytes", 0) > 0
+    assert counters.get("transfer.reuses", 0) > 0
+    assert any(k.startswith("transfer.phase.") and k.endswith(".bytes")
+               for k in counters)
+    assert gauges.get("device_table.enabled") == 1
+
+
+def test_device_codes_cached_per_column_object(monkeypatch):
+    """device_codes uploads once per column object and invalidates through
+    dataclasses.replace (table copies drop the cache on changed columns
+    only)."""
+    from delphi_tpu.ops import xfer
+    from delphi_tpu.table import encode_table
+
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    df = pd.DataFrame({"tid": ["0", "1", "2"],
+                       "a": ["x", "y", "x"], "b": ["u", "u", "v"]})
+    table = encode_table(df, "tid")
+    col_a, col_b = table.column("a"), table.column("b")
+    first_a = xfer.device_codes(col_a)
+    first_b = xfer.device_codes(col_b)
+    assert xfer.device_codes(col_a) is first_a  # cache hit
+
+    updated = table.with_updates([(1, "a", "x")])
+    assert xfer.cached_device_codes(updated.column("a")) is None  # replaced
+    assert xfer.device_codes(updated.column("b")) is first_b  # kept
+
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "0")
+    off = xfer.device_codes(col_a)
+    assert off is not first_a  # disabled plane re-uploads every call
+
+
+def test_pair_budget_env_and_fallback(monkeypatch):
+    """DELPHI_PAIR_BUDGET wins; the module attribute stays the fallback so
+    existing monkeypatched tests keep steering the launch split."""
+    from delphi_tpu.ops import freq
+
+    monkeypatch.setenv("DELPHI_PAIR_BUDGET", "1234")
+    assert freq._pair_keys_per_launch() == 1234.0
+    monkeypatch.delenv("DELPHI_PAIR_BUDGET")
+    monkeypatch.setattr(freq, "_PAIR_KEYS_PER_LAUNCH", 99)
+    assert freq._pair_keys_per_launch() == 99.0

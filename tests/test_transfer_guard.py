@@ -1,0 +1,37 @@
+"""Static guard for the upload seam: every host->device upload in the ops
+layer must route through ops/xfer.py (to_device / device_codes) so the
+transfer ledger sees it. A raw jnp.asarray / jax.device_put added anywhere
+else in delphi_tpu/ops/ is invisible to the ledger and silently breaks the
+bench's transfer accounting — this test fails the build instead."""
+
+import re
+from pathlib import Path
+
+OPS_DIR = Path(__file__).resolve().parent.parent / "delphi_tpu" / "ops"
+
+# the ONE allowlisted upload seam
+ALLOWED = {"xfer.py"}
+
+_UPLOAD = re.compile(r"\bjnp\.asarray\(|\bdevice_put\(")
+
+
+def test_ops_layer_has_no_raw_uploads_outside_seam():
+    offenders = []
+    for path in sorted(OPS_DIR.glob("*.py")):
+        if path.name in ALLOWED:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if _UPLOAD.search(line):
+                offenders.append(f"{path.name}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw host->device upload outside the ops/xfer.py seam "
+        "(use to_device/device_codes so the transfer ledger records it):\n"
+        + "\n".join(offenders))
+
+
+def test_seam_allowlist_is_minimal():
+    # the allowlist must keep pointing at real files; a rename that leaves
+    # a stale entry would quietly disable the guard
+    for name in ALLOWED:
+        assert (OPS_DIR / name).is_file()
