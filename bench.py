@@ -287,14 +287,7 @@ def smoke() -> int:
     # in the per-run snapshots without starting any server
     live._install_compile_listener()
 
-    n = 64
-    df = pd.DataFrame({
-        "tid": [str(i) for i in range(n)],
-        "c0": ["a" if i % 2 else "b" for i in range(n)],
-        "c1": [str(i % 4) for i in range(n)],
-        "c2": [str((i * 7) % 5) for i in range(n)],
-    })
-    df.loc[df.index % 11 == 0, "c1"] = None
+    df = _smoke_frame()
 
     def one_run(tag: str) -> dict:
         _heartbeat(f"smoke {tag} run")
@@ -334,7 +327,25 @@ def smoke() -> int:
         print("smoke FAILED: warm run recorded no compile-cache hits",
               file=sys.stderr)
         return 1
-    return transfer_smoke(df)
+    rc = transfer_smoke(df)
+    if rc:
+        return rc
+    return chaos_smoke(df)
+
+
+def _smoke_frame():
+    """The deterministic 64-row frame every smoke variant repairs."""
+    import pandas as pd
+
+    n = 64
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    })
+    df.loc[df.index % 11 == 0, "c1"] = None
+    return df
 
 
 def transfer_smoke(df) -> int:
@@ -414,6 +425,111 @@ def transfer_smoke(df) -> int:
               f"frames_equal={frames_equal})", file=sys.stderr)
         return 1
     return 0
+
+
+# Deterministic chaos plan: one transient upload fault (recovers on the first
+# retry) plus three consecutive OOMs at the domain bucket seam — enough to
+# exhaust the default retry budget (2) and force a degradation rung (shrink
+# when the bucket holds >1 attribute, evict otherwise). Every recovery path
+# on this plan is bit-identical by construction, which is exactly what the
+# A/B below asserts.
+CHAOS_PLAN = ("xfer.upload:1:transient,"
+              "domain.bucket:1:oom,domain.bucket:2:oom,domain.bucket:3:oom")
+
+
+def chaos_smoke(df=None) -> int:
+    """Resilience plane A/B: the same tiny CPU repair runs fault-free and
+    then under the deterministic CHAOS_PLAN (DELPHI_FAULT_PLAN). The chaos
+    run must survive (retry + degradation ladder), record resilience.*
+    counters matching the plan, and produce a BIT-IDENTICAL repair frame —
+    injected faults may change how work is launched, never what it
+    computes. Prints one JSON line; exit code 1 on failure."""
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.parallel import resilience
+    from delphi_tpu.session import get_session
+
+    if df is None:
+        df = _smoke_frame()
+
+    def one_run(tag: str, plan: str) -> dict:
+        _heartbeat(f"chaos smoke {tag} run")
+        # force the device domain-scoring route (the 64-row frame is far
+        # below the size gate) so the guarded bucket seam actually launches,
+        # and keep injected backoffs sub-millisecond
+        os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+        os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+        if plan:
+            os.environ["DELPHI_FAULT_PLAN"] = plan
+        resilience.reset_fault_state()
+        name = f"chaos_smoke_{tag}"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.chaos.{tag}")
+        try:
+            out = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+            os.environ.pop("DELPHI_FAULT_PLAN", None)
+            os.environ.pop("DELPHI_DOMAIN_DEVICE", None)
+            os.environ.pop("DELPHI_RETRY_BASE_S", None)
+            resilience.reset_fault_state()
+        counters = rec.registry.snapshot()["counters"]
+        res = {k: int(v) for k, v in counters.items()
+               if k.startswith("resilience.")}
+        return {
+            "resilience": res,
+            "frame": out.sort_values(list(out.columns))
+            .reset_index(drop=True),
+        }
+
+    baseline = one_run("clean", "")
+    injected = one_run("injected", CHAOS_PLAN)
+
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(baseline["frame"], injected["frame"])
+    except AssertionError:
+        frames_equal = False
+    for r in (baseline, injected):
+        del r["frame"]
+
+    res = injected["resilience"]
+    ok = frames_equal \
+        and res.get("resilience.injected", 0) == 4 \
+        and res.get("resilience.faults.transient", 0) >= 1 \
+        and res.get("resilience.faults.oom", 0) >= 3 \
+        and res.get("resilience.retries", 0) >= 3 \
+        and (res.get("resilience.degrade.shrink", 0)
+             + res.get("resilience.degrade.evict", 0)) >= 1 \
+        and not baseline["resilience"]
+    print(json.dumps({
+        "metric": "chaos_smoke",
+        "value": res.get("resilience.injected", 0),
+        "unit": "faults injected", "vs_baseline": None, "ok": ok,
+        "plan": CHAOS_PLAN, "frames_equal": frames_equal,
+        "clean": baseline["resilience"], "injected": res,
+    }), flush=True)
+    if not ok:
+        print("chaos smoke FAILED: injected-fault run must recover with "
+              f"bit-identical repairs and matching resilience counters "
+              f"(frames_equal={frames_equal}, counters={res})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def chaos() -> int:
+    """Standalone `bench.py --chaos` entry: CPU backend, deterministic
+    fault plan, bit-identical A/B (see chaos_smoke)."""
+    _force_cpu_backend()
+    return chaos_smoke(_smoke_frame())
 
 
 _READY_SENTINEL = "BENCH_BACKEND_READY"
@@ -631,12 +747,21 @@ def main() -> None:
                         help="tiny in-process CPU double-run asserting the "
                              "warm run records compile_cache.hits > 0; "
                              "exits 1 on failure")
+    parser.add_argument("--chaos", action="store_true",
+                        help="resilience A/B on the CPU backend: repairs the "
+                             "smoke frame fault-free and under a "
+                             "deterministic DELPHI_FAULT_PLAN, asserting "
+                             "bit-identical frames and matching "
+                             "resilience.* counters; exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args.smoke:
         sys.exit(smoke())
+
+    if args.chaos:
+        sys.exit(chaos())
 
     if args._child:
         _child_main(args)

@@ -88,6 +88,22 @@ def main(argv=None) -> int:
                              "scorecards only. Equivalent to "
                              "DELPHI_PROVENANCE_PATH / "
                              "repair.provenance.path")
+    parser.add_argument("--checkpoint-dir", dest="checkpoint_dir", type=str,
+                        default="",
+                        help="phase-level checkpoint/resume directory: each "
+                             "pipeline phase's outputs persist here "
+                             "(fingerprinted against the input table and "
+                             "options), so a killed run re-invoked with the "
+                             "same arguments resumes at the last completed "
+                             "phase. Equivalent to DELPHI_CHECKPOINT_DIR / "
+                             "repair.checkpoint.dir")
+    parser.add_argument("--fault-plan", dest="fault_plan", type=str,
+                        default="",
+                        help="deterministic fault-injection plan for chaos "
+                             "testing: comma-separated site:nth:kind triples "
+                             "injected at the guarded launch seam (see "
+                             "docs/source/robustness.rst). Equivalent to "
+                             "DELPHI_FAULT_PLAN / repair.fault.plan")
     parser.add_argument("--baseline-report", dest="baseline_report", type=str,
                         default="",
                         help="prior run-report JSON to compare this run's "
@@ -116,6 +132,10 @@ def main(argv=None) -> int:
         session.conf["repair.compile.cache_dir"] = args.compile_cache_dir
     if args.pipeline != "auto":
         session.conf["repair.pipeline.enabled"] = args.pipeline
+    if args.checkpoint_dir:
+        session.conf["repair.checkpoint.dir"] = args.checkpoint_dir
+    if args.fault_plan:
+        session.conf["repair.fault.plan"] = args.fault_plan
     if args.provenance_out:
         session.conf["repair.provenance.path"] = args.provenance_out
     elif args.baseline_report:

@@ -38,6 +38,7 @@ from delphi_tpu.train import (
     build_model, compute_class_nrow_stdv, rebalance_training_data, train_option_keys)
 from delphi_tpu.observability import active_ledger, counter_inc, gauge_set
 from delphi_tpu.observability import provenance as _prov
+from delphi_tpu.parallel import resilience as _resilience
 from delphi_tpu.utils import (
     argtype_check, elapsed_time, get_option_value, job_phase, log_based_on_level,
     phase_span, profile_trace, setup_logger, to_list_str)
@@ -1739,16 +1740,26 @@ class RepairModel:
     @job_phase(name="validating")
     def _validate_repairs(self, repair_candidates: pd.DataFrame,
                           repaired_rows: pd.DataFrame,
-                          clean_rows: pd.DataFrame) -> pd.DataFrame:
+                          clean_rows: pd.DataFrame,
+                          original_rows: Optional[pd.DataFrame] = None
+                          ) -> pd.DataFrame:
         """Post-repair constraint validation — implements the check the
         reference leaves as a TODO (model.py:1279-1285: "statistical models
         notoriously ignore specified integrity constraints"): the repaired
         dirty rows re-encode together with the clean context, every
         ConstraintErrorDetector's denial constraints re-evaluate over the
         result (the same device kernels phase 1 uses), and candidates whose
-        repaired cell STILL participates in a violation are dropped — the
-        cell stays unrepaired rather than swapping one violation for
-        another."""
+        repaired cell introduces a violation are dropped — the cell stays
+        unrepaired rather than swapping one violation for another.
+
+        When ``original_rows`` (the UNMASKED dirty rows) is given, a
+        candidate is dropped only if its cell violates AFTER the repair and
+        did NOT already violate BEFORE it: a correct repair landing next to
+        a pre-existing violation among the "clean" rows (undetected, so it
+        survives into the context) stays kept instead of being blamed for a
+        violation it didn't cause. Without ``original_rows`` the before-set
+        is empty and every after-violation drops (the conservative legacy
+        behavior)."""
         _logger.info("[Validation Phase] Validating {} repair candidates...".format(
             len(repair_candidates)))
         detectors = [d for d in self.error_detectors
@@ -1759,30 +1770,39 @@ class RepairModel:
         from delphi_tpu.ops.detect import detect_constraint_violations
         from delphi_tpu.table import encode_table
 
-        full = pd.concat([clean_rows, repaired_rows], ignore_index=True)
-        try:
-            encoded = encode_table(full, self._row_id)
-        except Exception as e:  # never fail the run on a validation error
-            _logger.warning(
-                f"Repair validation skipped: {e.__class__}: {e}")
-            return repair_candidates
-
         candidate_attrs = sorted(set(repair_candidates["attribute"]))
-        violating: set = set()
-        for d in detectors:
+
+        def violating_cells(dirty_block: pd.DataFrame) -> Optional[set]:
+            full = pd.concat([clean_rows, dirty_block], ignore_index=True)
             try:
-                parsed = d.parsed_constraints(encoded, str(self.input))
-            except Exception as e:
+                encoded = encode_table(full, self._row_id)
+            except Exception as e:  # never fail the run on a validation error
                 _logger.warning(
-                    f"Repair validation skipped for {d}: {e}")
-                continue
-            if parsed.is_empty:
-                continue
+                    f"Repair validation skipped: {e.__class__}: {e}")
+                return None
+            cells: set = set()
             rid_vals = full[self._row_id].to_numpy()
-            for rows, attr in detect_constraint_violations(
-                    encoded, parsed, candidate_attrs):
-                violating.update(
-                    (rid, attr) for rid in rid_vals[rows].tolist())
+            for d in detectors:
+                try:
+                    parsed = d.parsed_constraints(encoded, str(self.input))
+                except Exception as e:
+                    _logger.warning(
+                        f"Repair validation skipped for {d}: {e}")
+                    continue
+                if parsed.is_empty:
+                    continue
+                for rows, attr in detect_constraint_violations(
+                        encoded, parsed, candidate_attrs):
+                    cells.update(
+                        (rid, attr) for rid in rid_vals[rows].tolist())
+            return cells
+
+        after = violating_cells(repaired_rows)
+        if after is None or not after:
+            return repair_candidates
+        before = violating_cells(original_rows) \
+            if original_rows is not None else set()
+        violating = after - (before or set())
 
         if not violating:
             return repair_candidates
@@ -1800,7 +1820,7 @@ class RepairModel:
                     _prov.DECISION_KEPT, _prov.REASON_VALIDATION_VIOLATION)
             _logger.info(
                 f"[Validation Phase] Dropped {dropped}/{len(keys)} repairs "
-                "that still violate integrity constraints")
+                "that introduce integrity-constraint violations")
         return repair_candidates[keep].reset_index(drop=True)
 
     # -- run ------------------------------------------------------------------
@@ -1818,6 +1838,31 @@ class RepairModel:
         path = self._get_option_value(*self._opt_checkpoint_path)
         return os.path.join(path, "repair_models.pkl") if path else ""
 
+    @staticmethod
+    def _table_content_sha1(table: EncodedTable) -> str:
+        """Cheap content hash over an encoded table, shared by the model
+        checkpoint and the phase-checkpoint store (the hashed bytes are
+        unchanged from the original model-checkpoint implementation)."""
+        sampled = os.environ.get("DELPHI_CHECKPOINT_SAMPLED_HASH") == "1"
+        stride = max(1, table.n_rows // 65536) if sampled else 1
+        h = hashlib.sha1()
+        h.update(b"sampled" if sampled else b"full")
+        h.update(np.int64(table.n_rows).tobytes())
+        for c in table.columns:
+            h.update(c.name.encode("utf-8", "replace"))
+            h.update("\x00".join(str(v) for v in c.vocab).encode(
+                "utf-8", "replace"))
+            if sampled:
+                h.update(np.ascontiguousarray(c.codes[::stride]).tobytes())
+                if table.n_rows:
+                    h.update(np.ascontiguousarray(c.codes[-1:]).tobytes())
+            else:
+                # crc32 accepts any buffer — no .tobytes() copy (a second
+                # ~400MB allocation per column at the 1e8-row north star)
+                crc = zlib.crc32(np.ascontiguousarray(c.codes))
+                h.update(np.uint32(crc).tobytes())
+        return h.hexdigest()
+
     def _checkpoint_fingerprint(self, masked: EncodedTable,
                                 target_columns: List[str]) -> Dict[str, Any]:
         """Identity of a trained-model set: the input table name, its shape
@@ -1832,25 +1877,7 @@ class RepairModel:
         # the bounded stride sample instead (~O(1) rows hashed), accepting
         # that an edit off the sample lattice reusing existing vocab entries
         # can slip past.
-        sampled = os.environ.get("DELPHI_CHECKPOINT_SAMPLED_HASH") == "1"
-        stride = max(1, masked.n_rows // 65536) if sampled else 1
-        h = hashlib.sha1()
-        h.update(b"sampled" if sampled else b"full")
-        h.update(np.int64(masked.n_rows).tobytes())
-        for c in masked.columns:
-            h.update(c.name.encode("utf-8", "replace"))
-            h.update("\x00".join(str(v) for v in c.vocab).encode(
-                "utf-8", "replace"))
-            if sampled:
-                h.update(np.ascontiguousarray(c.codes[::stride]).tobytes())
-                if masked.n_rows:
-                    h.update(np.ascontiguousarray(c.codes[-1:]).tobytes())
-            else:
-                # crc32 accepts any buffer — no .tobytes() copy (a second
-                # ~400MB allocation per column at the 1e8-row north star)
-                crc = zlib.crc32(np.ascontiguousarray(c.codes))
-                h.update(np.uint32(crc).tobytes())
-        content = h.hexdigest()
+        content = self._table_content_sha1(masked)
         return {
             "version": 4,
             "input": self._session.qualified_name(
@@ -1914,6 +1941,55 @@ class RepairModel:
         except Exception as e:
             _logger.warning(f"Failed to write model checkpoint {ckpt}: {e}")
 
+    # -- phase-level checkpoint/resume (resilience plane) ---------------------
+    #
+    # Orthogonal to `model.checkpoint_path` (which caches trained models
+    # across runs keyed on the MASKED table): `DELPHI_CHECKPOINT_DIR` /
+    # `repair.checkpoint.dir` persists each pipeline phase's outputs keyed on
+    # the INPUT table, so a run killed mid-pipeline (crash, watchdog
+    # checkpoint-and-abort) resumes at the last completed phase with
+    # bit-identical results.
+
+    def _phase_fingerprint(self, table: EncodedTable,
+                           continuous_columns: List[str]) -> Dict[str, Any]:
+        """Identity of a run's phase outputs: everything they deterministically
+        derive from — the input table (name, schema, content hash), the
+        continuous-column split, and every expert option/setter knob."""
+        return {
+            "version": 1,
+            "input": self._session.qualified_name(
+                self.db_name,
+                self.input if isinstance(self.input, str) else "<dataframe>"),
+            "columns": [self._row_id] + table.column_names,
+            "n_rows": int(table.n_rows),
+            "content_sha1": self._table_content_sha1(table),
+            "continuous": sorted(continuous_columns),
+            "opts": dict(sorted(self.opts.items())),
+            "targets": sorted(self.cf.targets) if self.cf is not None else [],
+            "discrete_thres": int(self.discrete_thres),
+            "repair_by_rules": bool(self.repair_by_rules),
+            "rebalancing": bool(self.training_data_rebalancing_enabled),
+        }
+
+    def _phase_checkpoint_store(
+            self, table: EncodedTable, continuous_columns: List[str]
+    ) -> Optional["_resilience.PhaseCheckpointStore"]:
+        directory = _resilience.checkpoint_dir()
+        if not directory:
+            return None
+        if table.process_local:
+            # phase payloads are per-process row shards here; resuming one
+            # shard against another's checkpoint would silently mix rows
+            _logger.warning("phase checkpointing skipped: not supported on "
+                            "process-local (sharded-ingestion) tables")
+            return None
+        try:
+            fp = self._phase_fingerprint(table, continuous_columns)
+        except Exception as e:  # checkpointing must never fail the run
+            _logger.warning(f"phase checkpointing disabled: {e}")
+            return None
+        return _resilience.PhaseCheckpointStore(directory, fp)
+
     @elapsed_time  # type: ignore
     def _run(self, table: EncodedTable, input_name: str,
              continuous_columns: List[str], detect_errors_only: bool,
@@ -1962,13 +2038,28 @@ class RepairModel:
                   compute_repair_prob: bool,
                   compute_repair_score: bool, repair_data: bool,
                   maximal_likelihood_repair: bool) -> pd.DataFrame:
+        phase_store = self._phase_checkpoint_store(table, continuous_columns)
+
         #######################################################################
         # 1. Error Detection Phase
         #######################################################################
-        _logger.info(
-            f"[Error Detection Phase] Detecting errors in a table `{input_name}`... ")
-        error_cells_df, target_columns, pairwise_attr_stats, domain_stats = \
-            self._detect_errors(table, input_name, continuous_columns)
+        detect_ckpt = phase_store.load("detect") if phase_store else None
+        if detect_ckpt is not None:
+            error_cells_df, target_columns, pairwise_attr_stats, \
+                domain_stats = detect_ckpt
+        else:
+            _logger.info(
+                f"[Error Detection Phase] Detecting errors in a table "
+                f"`{input_name}`... ")
+            error_cells_df, target_columns, pairwise_attr_stats, \
+                domain_stats = self._detect_errors(
+                    table, input_name, continuous_columns)
+            if phase_store:
+                phase_store.save("detect", (error_cells_df, target_columns,
+                                            pairwise_attr_stats, domain_stats))
+        # watchdog checkpoint-and-abort lands between phases: the completed
+        # phase's checkpoint is already on disk, so the resume is lossless
+        _resilience.maybe_abort()
         gauge_set("pipeline.error_cells", int(len(error_cells_df)))
         gauge_set("pipeline.target_columns", len(target_columns))
 
@@ -2043,15 +2134,23 @@ class RepairModel:
         # sharded pipeline skips checkpointing
         fingerprint = self._checkpoint_fingerprint(masked, target_columns) \
             if self._checkpoint_file() and not table.process_local else {}
-        models = self._load_model_checkpoint(fingerprint) if fingerprint else None
+        # resume layering: the phase store (keyed on the input table) is
+        # checked first, then the cross-run model checkpoint (keyed on the
+        # masked table), then training runs for real
+        models = phase_store.load("train") if phase_store else None
         if models is None:
-            models = self._build_repair_models(
-                masked, float_cols, target_columns, continuous_columns,
-                domain_stats, pairwise_attr_stats)
-            if fingerprint:
-                self._save_model_checkpoint(models, fingerprint)
-        else:
-            counter_inc("train.checkpoint_hits")
+            models = self._load_model_checkpoint(fingerprint) if fingerprint else None
+            if models is None:
+                models = self._build_repair_models(
+                    masked, float_cols, target_columns, continuous_columns,
+                    domain_stats, pairwise_attr_stats)
+                if fingerprint:
+                    self._save_model_checkpoint(models, fingerprint)
+            else:
+                counter_inc("train.checkpoint_hits")
+            if phase_store:
+                phase_store.save("train", models)
+        _resilience.maybe_abort()
         for _, (model, _, _) in models:
             if isinstance(model, PoorModel):
                 counter_inc("train.poor_models")
@@ -2196,8 +2295,14 @@ class RepairModel:
                 assume_unique=True)
             clean_rows_df = masked.to_pandas(
                 rows=clean_pos, integral_as_float=float_cols)
+            # the UNMASKED dirty rows: the before-frame of the validation
+            # diff, so a cell that already violated pre-repair can't get a
+            # correct repair dropped for a violation it didn't introduce
+            original_rows_df = table.to_pandas(
+                rows=error_row_pos, integral_as_float=float_cols)
             repair_candidates_df = self._validate_repairs(
-                repair_candidates_df, repaired_rows_df, clean_rows_df)
+                repair_candidates_df, repaired_rows_df, clean_rows_df,
+                original_rows_df)
         return repair_candidates_df
 
     def _extract_repair_candidates(self, repaired_rows_df: pd.DataFrame,
@@ -2231,7 +2336,11 @@ class RepairModel:
         local = np.searchsorted(row_pos, cells_rows)
         attrs_np = error_cells_df["attribute"].to_numpy(dtype=object)
         curs_np = error_cells_df["current_value"].to_numpy(dtype=object)
-        rid_np = error_cells_df[self._row_id].to_numpy()
+        # object dtype for legacy parity: the reference's SQL flatten+join
+        # keyed row ids as plain values, so an integer-keyed table must not
+        # come back with a numpy-int64 column where callers (and the
+        # provenance ledger) expect Python scalars
+        rid_np = error_cells_df[self._row_id].to_numpy(dtype=object)
         attr_codes, attr_uniques = pd.factorize(attrs_np)
         col_rank = {a: i for i, a in enumerate(repaired_rows_df.columns)}
         target_set = set(target_columns)
@@ -2326,6 +2435,11 @@ class RepairModel:
         in-process) and aggregated into per-attribute quality scorecards in
         the run report."""
         from delphi_tpu import observability as obs
+
+        # a fresh run starts with clean resilience latches: an abort armed by
+        # a previous run's watchdog (or its CPU fallback) must not leak in
+        _resilience.clear_abort()
+        _resilience.clear_cpu_fallback()
 
         report_path = obs.metrics_path()
         recorder = None

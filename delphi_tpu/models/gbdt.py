@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from delphi_tpu.parallel.resilience import run_guarded
+
 MAX_MULTICLASS = 24
 
 
@@ -942,17 +944,22 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                     if done[t] or not active[t]:
                         rows.append(stats_buf[i])
                         continue
-                    dvi[9], s = fn(*dvi, lrs, regs, msgs, mcws)
+                    dvi[9], s = run_guarded(
+                        "gbdt.cv_chunk",
+                        lambda dvi=dvi: fn(*dvi, lrs, regs, msgs, mcws))
                     rows.append(np.asarray(jax.device_get(s)))
                 stats_buf = rows
                 stats_np = np.stack(rows)
             else:
                 parts = []
                 for sd in slab_data:
-                    sd["F"], s = fn(sd["bins"], sd["y"], sd["w"], sd["val"],
-                                    sd["ycmp"], sd["log"], sd["iscale"],
-                                    sd["cw"], sd["valid"], sd["F"],
-                                    lrs, regs, msgs, mcws)
+                    sd["F"], s = run_guarded(
+                        "gbdt.cv_chunk",
+                        lambda sd=sd: fn(
+                            sd["bins"], sd["y"], sd["w"], sd["val"],
+                            sd["ycmp"], sd["log"], sd["iscale"],
+                            sd["cw"], sd["valid"], sd["F"],
+                            lrs, regs, msgs, mcws))
                     parts.append(np.asarray(jax.device_get(s))[:sd["n"]])
                 stats_np = np.concatenate(parts, axis=0)
             rounds_done += chunk
@@ -1433,9 +1440,15 @@ def gbdt_fit_batch(entries: List[Tuple["GradientBoostedTreesModel",
         rounds_max = max(m.n_estimators for m in models)
         parts = []
         for chunk in _round_chunks(rounds_max):
-            F, trees = boost(
-                bins, ys, ws, F, lrs, regs, msgs, mcws, mcss, chunk,
-                depth, n_bins, n_nodes, objective, k, use_counts)
+            # guarded launch; note the donated-F caveat: a REAL fault that
+            # fires after donation invalidates F, and the retry's
+            # deleted-array error is unclassifiable and re-raises — only
+            # faults at launch entry (injection, dispatch) retry cleanly
+            F, trees = run_guarded(
+                "gbdt.fit_chunk",
+                lambda F=F: boost(
+                    bins, ys, ws, F, lrs, regs, msgs, mcws, mcss, chunk,
+                    depth, n_bins, n_nodes, objective, k, use_counts))
             parts.append(jax.device_get(trees))
         for mi, m in enumerate(models):
             own = [tuple(np.asarray(t)[mi] for t in p) for p in parts]

@@ -308,6 +308,17 @@ class _Watchdog(threading.Thread):
                                 "idle_s": round(idle_s, 3),
                                 "active": rec.active_spans()})
                 _dump_thread_stacks(rec, idle_s)
+                # checkpoint-and-abort (parallel/resilience.py): with a
+                # checkpoint dir configured (or DELPHI_STALL_ABORT), a
+                # stalled run aborts at the next guarded seam entry / phase
+                # boundary — the last completed phase is already persisted —
+                # instead of hanging forever after the stack dump
+                try:
+                    from delphi_tpu.parallel.resilience import \
+                        on_watchdog_stall
+                    on_watchdog_stall(rec, idle_s)
+                except Exception as e:
+                    _logger.warning(f"stall abort hook failed: {e}")
 
 
 # -- resource sampler --------------------------------------------------------

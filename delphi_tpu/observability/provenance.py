@@ -128,11 +128,25 @@ class ProvenanceLedger:
         # frames carry both); lets position-keyed phases (domain scoring)
         # land on the same entries as id-keyed phases (repair decisions).
         self._rid_of: Dict[int, str] = {}
+        self._notes: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._written = False
 
     def __len__(self) -> int:
         return len(self._cells)
+
+    def record_note(self, kind: str, detail: str) -> None:
+        """Run-level annotation (not keyed to a cell): the resilience plane
+        stamps one per degradation that changed a decision path — shrink /
+        evict / CPU fallback — so an audited re-run can see that this run's
+        dispatch diverged from the fault-free plan and why."""
+        with self._lock:
+            self._notes.append({"note": kind, "detail": detail,
+                                "seq": len(self._notes)})
+
+    def notes(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(n) for n in self._notes]
 
     def _entry(self, rid: str, attr: str) -> Dict[str, Any]:
         key = (rid, attr)
@@ -318,6 +332,10 @@ class ProvenanceLedger:
                 with os.fdopen(fd, "w") as f:
                     for e in self.entries():
                         f.write(json.dumps(e, default=str) + "\n")
+                    # run-level notes (resilience degradations) ride in the
+                    # same JSONL stream, distinguished by the "note" key
+                    for n in self.notes():
+                        f.write(json.dumps(n, default=str) + "\n")
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self.path)

@@ -28,6 +28,7 @@ from delphi_tpu.session import AnalysisException
 from delphi_tpu.table import EncodedTable, NULL_CODE
 from delphi_tpu.observability import active_ledger, counter_inc
 from delphi_tpu.ops.xfer import to_device
+from delphi_tpu.parallel import resilience
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -150,19 +151,15 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
     fences = {}
     device_pools = [p for p in pools if _use_device_detect(len(p[4]))]
     if device_pools:
-        import jax.numpy as jnp
-        from jax.experimental import enable_x64
         longest = max(len(p[4]) for p in device_pools)
         padded = np.full((len(device_pools), longest), np.nan,
                          dtype=np.float64)
         for i, (_, _, _, _, pool) in enumerate(device_pools):
             padded[i, :len(pool)] = pool
-        with enable_x64():
-            qs = np.asarray(jnp.nanpercentile(
-                to_device(padded),
-                to_device(np.asarray([25.0, 75.0])), axis=1))
-        for i, (attr, _, _, _, _) in enumerate(device_pools):
-            fences[attr] = (qs[0, i], qs[1, i])
+        qs = _guarded_percentile_batch(padded)
+        if qs is not None:
+            for i, (attr, _, _, _, _) in enumerate(device_pools):
+                fences[attr] = (qs[0, i], qs[1, i])
 
     for attr, col, values, valid, pool in pools:
         if attr in fences:
@@ -179,6 +176,44 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
             counter_inc("detect.outlier_cells", rows.size)
             out.append((rows, attr))
     return out
+
+
+def _guarded_percentile_batch(padded: np.ndarray) -> Optional[np.ndarray]:
+    """The batched q1/q3 device launch under the resilience plane: OOM
+    exhaustion halves the attribute batch (each row reduces independently,
+    so the split is value-identical), and a fault that survives the whole
+    ladder falls back to the host percentile path (the caller treats a
+    ``None`` as 'no device fences' and computes per attribute on host)."""
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from delphi_tpu.parallel import resilience
+
+    def launch(block: np.ndarray) -> np.ndarray:
+        with enable_x64():
+            return np.asarray(jnp.nanpercentile(
+                to_device(block),
+                to_device(np.asarray([25.0, 75.0])), axis=1))
+
+    def guarded(block: np.ndarray) -> np.ndarray:
+        try:
+            return resilience.run_guarded(
+                "detect.percentile", lambda: launch(block),
+                can_shrink=block.shape[0] > 1)
+        except resilience.ShrinkBatch:
+            half = (block.shape[0] + 1) // 2
+            return np.concatenate(
+                [guarded(block[:half]), guarded(block[half:])], axis=1)
+
+    try:
+        return guarded(padded)
+    except Exception as e:
+        if resilience.classify_fault(e) is None:
+            raise
+        _logger.warning(
+            f"device percentile batch failed ({type(e).__name__}: {e}); "
+            "falling back to host per-attribute fences")
+        return None
 
 
 def _shared_codes_sized(table: EncodedTable, left: str, right: str) \
@@ -417,7 +452,8 @@ def _device_fused_ranks(halves: Sequence[Tuple[np.ndarray, np.ndarray]],
                 key = to_device(_pad_pow2(both, big))
             else:
                 key = inv * stride + to_device(_pad_pow2(both, 0))
-            inv = _rank_kernel(key)
+            inv = resilience.run_guarded(
+                "detect.rank", lambda key=key: _rank_kernel(key))
         if return_inv:
             return inv
         ranks = np.asarray(inv)[:2 * n]
@@ -458,9 +494,11 @@ def _device_sorted_count(keys2: np.ndarray, keys1: np.ndarray) -> np.ndarray:
     n = len(keys1)
     big = np.iinfo(np.int64).max
     with enable_x64():
-        out = _sorted_count_kernel(
-            to_device(_pad_pow2(keys2.astype(np.int64), big)),
-            to_device(_pad_pow2(keys1.astype(np.int64), big - 1)))
+        out = resilience.run_guarded(
+            "detect.sorted_count",
+            lambda: _sorted_count_kernel(
+                to_device(_pad_pow2(keys2.astype(np.int64), big)),
+                to_device(_pad_pow2(keys1.astype(np.int64), big - 1))))
         out = np.asarray(out)
     return out[:n]
 
@@ -485,8 +523,10 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
     g = _pad_pow2(groups.astype(np.int64), n_groups)
     seg_pad = max(8, 1 << (max(n_groups + 1, 1) - 1).bit_length())
     with enable_x64():
-        out = np.asarray(_group_extrema_kernel(
-            to_device(v), to_device(g), seg_pad, want_max))
+        out = np.asarray(resilience.run_guarded(
+            "detect.group_extrema",
+            lambda: _group_extrema_kernel(
+                to_device(v), to_device(g), seg_pad, want_max)))
     return out[:n_groups]
 
 

@@ -462,11 +462,15 @@ def _score_cells_device(codes_chunk, pair_tables, taus, has_single,
         _score_kernel = _jit_score_kernel()
     if operand_cache is None:
         operand_cache = {}
+    from delphi_tpu.parallel.resilience import run_guarded
+
     codes, cells, v_a = _pad_chunk_operands(
         codes_chunk, pair_tables, taus, has_single, operand_cache)
-    big, tiny, contributed = _score_kernel(
-        to_device(codes), operand_cache["tables"], operand_cache["taus"],
-        operand_cache["hs"])
+    big, tiny, contributed = run_guarded(
+        "domain.score",
+        lambda: _score_kernel(
+            to_device(codes), operand_cache["tables"],
+            operand_cache["taus"], operand_cache["hs"]))
     return (np.asarray(big)[:cells, :v_a].astype(np.int64),
             np.asarray(tiny)[:cells, :v_a].astype(np.int64),
             np.asarray(contributed)[:cells, :v_a])
@@ -516,14 +520,18 @@ def _weak_label_chunk_device(codes_chunk, pair_tables, taus, has_single,
 
     if _weak_kernel is None:
         _weak_kernel = _jit_weak_label_kernel()
+    from delphi_tpu.parallel.resilience import run_guarded
+
     with enable_x64():
         codes, cells, v_a = _pad_chunk_operands(
             codes_chunk, pair_tables, taus, has_single, operand_cache,
             vocab_rank=vocab_rank)
-        has_domain, top = _weak_kernel(
-            to_device(codes), operand_cache["tables"],
-            operand_cache["taus"], operand_cache["hs"],
-            operand_cache["rank"], float(beta), float(n_rows))
+        has_domain, top = run_guarded(
+            "domain.weak_label",
+            lambda: _weak_kernel(
+                to_device(codes), operand_cache["tables"],
+                operand_cache["taus"], operand_cache["hs"],
+                operand_cache["rank"], float(beta), float(n_rows)))
         return (np.asarray(has_domain)[:cells], np.asarray(top)[:cells])
 
 
@@ -747,9 +755,10 @@ def _bucketed_run(table, jobs, beta=None):
             if id(c) not in col_slot:
                 col_slot[id(c)] = len(cols)
                 cols.append(c)
-    base = jnp.stack([xfer.device_codes(c) for c in cols])
-    all_codes = jnp.pad(base, ((0, 0), (0, 1)), constant_values=NULL_CODE)
-    sentinel = int(base.shape[1])
+    # mutable holder: the resilience plane's 'evict' rung re-uploads the
+    # column buffers and restacks the resident matrix in place
+    codes_state = {"cols": cols, "all_codes": _stack_all_codes(cols)}
+    sentinel = int(cols[0].codes.shape[0]) if cols else 0
 
     chunk = _chunk_cells()
     out = {j[0]: [] for j in jobs}
@@ -773,14 +782,45 @@ def _bucketed_run(table, jobs, beta=None):
                            _BUCKET_TABLE_ELEMS // max(per_tables, 1)))
         for s in range(0, len(pieces), b_max):
             _launch_bucket(pieces[s:s + b_max], fused, k, va_pad, vc_pad,
-                           rows_pad, all_codes, sentinel, beta, out)
+                           rows_pad, codes_state, sentinel, beta, out)
     for gi in out:
         out[gi].sort(key=lambda t: t[0])
     return out
 
 
-def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, all_codes,
+def _stack_all_codes(cols):
+    """Stacks the distinct correlate columns' device-resident codes into the
+    [cols, rows+1] gather matrix, with one trailing sentinel row of NULL
+    codes so padded row indices gather an always-inactive cell."""
+    import jax.numpy as jnp
+
+    from delphi_tpu.ops import xfer
+
+    base = jnp.stack([xfer.device_codes(c) for c in cols])
+    return jnp.pad(base, ((0, 0), (0, 1)), constant_values=NULL_CODE)
+
+
+def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, codes_state,
                    sentinel, beta, out):
+    """Guarded bucket launch: on OOM-exhausted retries the resilience plane
+    signals ShrinkBatch and the padded batch halves recursively — results
+    are assembled per piece, so the split is bit-identical to the one-shot
+    launch, just more programs."""
+    from delphi_tpu.parallel import resilience
+
+    try:
+        return _launch_bucket_once(batch, fused, k, va_pad, vc_pad, rows_pad,
+                                   codes_state, sentinel, beta, out)
+    except resilience.ShrinkBatch:
+        half = (len(batch) + 1) // 2
+        _launch_bucket(batch[:half], fused, k, va_pad, vc_pad, rows_pad,
+                       codes_state, sentinel, beta, out)
+        _launch_bucket(batch[half:], fused, k, va_pad, vc_pad, rows_pad,
+                       codes_state, sentinel, beta, out)
+
+
+def _launch_bucket_once(batch, fused, k, va_pad, vc_pad, rows_pad,
+                        codes_state, sentinel, beta, out):
     global _bucket_kernel_int, _bucket_kernel_fused
     b = len(batch)
     b_pad = 1 << (b - 1).bit_length()
@@ -810,14 +850,29 @@ def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, all_codes,
     counter_inc("domain.bucket_launches")
     counter_inc("domain.bucket_pieces", b)
 
+    from delphi_tpu.ops import xfer
+    from delphi_tpu.parallel.resilience import run_guarded
+
+    def evict():
+        # transfer-fault rung: re-upload the resident column buffers and
+        # restack the gather matrix before the retry
+        xfer.evict_device_codes(codes_state["cols"])
+        codes_state["all_codes"] = _stack_all_codes(codes_state["cols"])
+
     if fused:
         from jax.experimental import enable_x64
         if _bucket_kernel_fused is None:
             _bucket_kernel_fused = _jit_bucket_kernel(True)
-        with enable_x64():
-            has_domain, top = _bucket_kernel_fused(
-                to_device(blob_np), all_codes, b_pad, k, va_pad, vc_pad,
-                rows_pad, float(beta), n_rows)
+
+        def launch_fused():
+            with enable_x64():
+                return _bucket_kernel_fused(
+                    to_device(blob_np), codes_state["all_codes"], b_pad, k,
+                    va_pad, vc_pad, rows_pad, float(beta), n_rows)
+
+        has_domain, top = run_guarded(
+            "domain.bucket", launch_fused, can_shrink=len(batch) > 1,
+            evict=evict)
         has_domain = np.asarray(has_domain)
         top = np.asarray(top)
         for i, (gi, lo, sub, prep, cidx) in enumerate(batch):
@@ -827,9 +882,12 @@ def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, all_codes,
 
     if _bucket_kernel_int is None:
         _bucket_kernel_int = _jit_bucket_kernel(False)
-    big, tiny, contributed = _bucket_kernel_int(
-        to_device(blob_np), all_codes, b_pad, k, va_pad, vc_pad, rows_pad,
-        0.0, 1.0)
+    big, tiny, contributed = run_guarded(
+        "domain.bucket",
+        lambda: _bucket_kernel_int(
+            to_device(blob_np), codes_state["all_codes"], b_pad, k, va_pad,
+            vc_pad, rows_pad, 0.0, 1.0),
+        can_shrink=len(batch) > 1, evict=evict)
     big = np.asarray(big)
     tiny = np.asarray(tiny)
     contributed = np.asarray(contributed)

@@ -235,7 +235,9 @@ def compute_freq_stats(table: EncodedTable,
             [xfer.device_codes(table.column(a)) for a in needed], axis=1)
     else:
         codes = xfer.to_device(table.codes(needed))
-    singles_arr = np.asarray(_batched_single_counts(codes, v_pad))
+    from delphi_tpu.parallel.resilience import run_guarded
+    singles_arr = np.asarray(run_guarded(
+        "freq.singles", lambda: _batched_single_counts(codes, v_pad)))
     singles = {a: singles_arr[name_to_idx[a], : vocab_sizes[a] + 1] for a in needed}
 
     # Per-pair routing: pairs whose vocabularies fit the MXU kernel's VMEM/
@@ -252,9 +254,11 @@ def compute_freq_stats(table: EncodedTable,
         from delphi_tpu.ops.pallas_kernels import pallas_pair_counts
 
         for x, y in mxu_pairs:
-            pair_mats[(x, y)] = pallas_pair_counts(
-                codes[:, name_to_idx[x]], codes[:, name_to_idx[y]],
-                vocab_sizes[x], vocab_sizes[y])
+            pair_mats[(x, y)] = run_guarded(
+                "freq.pairs_pallas",
+                lambda x=x, y=y: pallas_pair_counts(
+                    codes[:, name_to_idx[x]], codes[:, name_to_idx[y]],
+                    vocab_sizes[x], vocab_sizes[y]))
     if xla_pairs:
         stride = v_pad + 1
         # The vmapped kernel materializes a [pairs, rows] fused-key buffer;
@@ -268,7 +272,10 @@ def compute_freq_stats(table: EncodedTable,
             xy = xfer.to_device(np.asarray(
                 [[name_to_idx[x] for x, _ in group],
                  [name_to_idx[y] for _, y in group]], dtype=np.int32))
-            flat = np.asarray(_batched_pair_counts(codes, xy[0], xy[1], v_pad))
+            flat = np.asarray(run_guarded(
+                "freq.pairs",
+                lambda xy=xy: _batched_pair_counts(codes, xy[0], xy[1],
+                                                   v_pad)))
             for p, (x, y) in enumerate(group):
                 m = flat[p].reshape(stride, stride)
                 pair_mats[(x, y)] = \
@@ -406,7 +413,10 @@ class PairDistinctCounter:
                     [self._table.column(x).codes for x, _ in padded]))
                 c2 = xfer.to_device(np.stack(
                     [self._table.column(y).codes for _, y in padded]))
-            counts = np.asarray(_batched_distinct_pair_counts(c1, c2))
+            from delphi_tpu.parallel.resilience import run_guarded
+            counts = np.asarray(run_guarded(
+                "freq.distinct",
+                lambda c1=c1, c2=c2: _batched_distinct_pair_counts(c1, c2)))
             local_counts.extend(int(c) for c in counts[:len(chunk)])
         for (x, y), c in zip(todo, self._merge_global_many(local_counts)):
             self._cache[frozenset((x, y))] = c

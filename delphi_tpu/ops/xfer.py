@@ -80,12 +80,17 @@ def to_device(x: Any, dtype: Any = None):
     import jax
     import jax.numpy as jnp
 
+    from delphi_tpu.parallel.resilience import run_guarded
+
     if isinstance(x, jax.Array):
         counter_inc("transfer.reuses")
         return x if dtype is None else x.astype(dtype)
     arr = np.asarray(x) if dtype is None else np.asarray(x, dtype=dtype)
     record_transfer(arr.nbytes)
-    return jnp.asarray(arr)
+    # the upload itself runs under the resilience plane: transient/transfer
+    # faults retry with backoff, repeated device faults latch the CPU
+    # fallback for the phase (parallel/resilience.py)
+    return run_guarded("xfer.upload", lambda: jnp.asarray(arr))
 
 
 def device_codes(col):
@@ -108,3 +113,22 @@ def device_codes(col):
 def cached_device_codes(col) -> Optional[Any]:
     """The column's cached device buffer, or ``None`` when cold (tests)."""
     return getattr(col, _DEVICE_CODES_ATTR, None)
+
+
+def evict_device_codes(cols) -> int:
+    """Drops the device-resident code buffers of ``cols`` so the next
+    :func:`device_codes` call re-uploads from host — the resilience plane's
+    'evict' degradation rung for transfer faults (a device that lost or
+    corrupted its buffers gets a fresh copy of ground truth). Returns the
+    number of buffers evicted."""
+    n = 0
+    for col in cols:
+        if getattr(col, _DEVICE_CODES_ATTR, None) is not None:
+            try:
+                delattr(col, _DEVICE_CODES_ATTR)
+                n += 1
+            except AttributeError:  # pragma: no cover - concurrent evict
+                pass
+    if n:
+        counter_inc("transfer.evictions", n)
+    return n

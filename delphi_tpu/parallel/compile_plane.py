@@ -242,6 +242,11 @@ def _prewarm_worker(handle: PrewarmHandle,
             # — record it and leave the real shapes to plain JIT
             handle.error = e
             counter_inc("compile_plane.prewarm_errors")
+            try:
+                from delphi_tpu.parallel.resilience import note_fault
+                note_fault(e, "compile.prewarm")
+            except Exception:  # taxonomy is telemetry, never fatal here
+                pass
             _logger.warning(
                 f"AOT prewarm stopped on {v}: {type(e).__name__}: {e}")
             break

@@ -128,11 +128,16 @@ def _default_mesh() -> Tuple[Optional[Mesh], bool]:
     TESTING construct, opted into via DELPHI_MESH. Returns (mesh, cacheable):
     a failed backend probe is NOT cacheable — the caller must retry it."""
     from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+    from delphi_tpu.parallel import resilience
     maybe_initialize_distributed()
     try:
-        n = len(jax.devices())
+        # hard-deadline probe: a wedged TPU runtime raises BackendInitTimeout
+        # (DELPHI_INIT_DEADLINE_S) instead of hanging the run forever
+        devices = resilience.probe_backend()
+        n = len(devices)
         backend = jax.default_backend()
-    except Exception:  # backend init failure -> single-device, uncached
+    except Exception as e:  # backend init failure -> single-device, uncached
+        resilience.note_fault(e, "backend.init")
         return None, False
     if n > 1 and (backend == "tpu" or jax.process_count() > 1):
         return make_mesh(), True

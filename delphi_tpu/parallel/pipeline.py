@@ -89,10 +89,16 @@ def run_pipelined(items: Sequence[Any],
     """Runs ``consume(item, prepare(item))`` over ``items``, overlapping
     ``prepare`` of the next items with ``consume`` of the current one.
     Returns the list of ``consume`` results, in item order."""
+    from delphi_tpu.parallel.resilience import maybe_abort
+
     items = list(items)
     if len(items) <= 1 or not enabled():
         # the sequential loop IS the disabled path: zero threads, zero queues
-        return [consume(it, prepare(it)) for it in items]
+        out = []
+        for it in items:
+            maybe_abort()
+            out.append(consume(it, prepare(it)))
+        return out
 
     counter_inc("pipeline.runs")
     counter_inc("pipeline.items", len(items))
@@ -118,6 +124,9 @@ def run_pipelined(items: Sequence[Any],
     results: List[Any] = []
     try:
         for _ in range(len(items)):
+            # watchdog checkpoint-and-abort: stop dispatching queued work
+            # as soon as an abort is armed (prepared-ahead items discard)
+            maybe_abort()
             t0 = time.perf_counter()
             idx, prep, err = q.get()
             histogram_observe("pipeline.consumer_wait_seconds",

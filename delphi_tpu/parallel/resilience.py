@@ -1,0 +1,641 @@
+"""Resilience plane: the guarded-launch seam every device launch and upload
+routes through, plus the fault-injection harness, the phase-checkpoint
+store, and the backend-init hard-deadline probe.
+
+The repair pipeline's device work all funnels through a handful of call
+sites (the ops/xfer.py upload seam, the bucketed domain/weak-label launches,
+the GBDT CV chunks and batched fits, the outlier-percentile batch). Each of
+those sites wraps its launch in :func:`run_guarded`, which
+
+* **classifies** any raised exception into a small fault taxonomy —
+  ``init_timeout`` / ``oom`` / ``transfer`` / ``compile`` / ``transient`` —
+  via :func:`classify_fault` (unclassifiable exceptions are program bugs and
+  re-raise immediately);
+* **retries** classified faults with bounded exponential backoff and
+  deterministic jitter (:class:`RetryPolicy` — no randomness, so a replay
+  with the same fault plan sleeps the same schedule);
+* on retry exhaustion walks a **degradation ladder** instead of dying:
+  *shrink* (signal the call site to halve its padded batch via
+  :class:`ShrinkBatch` — bit-identical by construction, every launch route
+  assembles per-piece results), then *evict* (drop device-resident buffers
+  and re-upload through the caller's ``evict`` callback), then *CPU
+  fallback* (latch ``jax.default_device(cpu)`` for the remainder of the
+  current phase), and only then re-raise.
+
+Every event lands in the run report and the live ``/metrics`` endpoint as
+``resilience.*`` counters / histograms, and each degradation that changed a
+decision path is stamped into the provenance ledger as a run note.
+
+**Fault injection** (``DELPHI_FAULT_PLAN`` / ``repair.fault.plan``):
+``site:nth:kind`` triples, comma-separated — e.g.
+``backend.init:1:init_timeout,domain.bucket:2:oom`` — injected at the
+guarded seam on the *nth* entry of a matching site (``fnmatch`` wildcards
+allowed; attempts count, so ``site:1:oom,site:2:oom`` survives a retry
+budget of one). Each triple fires exactly once and the injected exception
+carries a realistic message so the REAL classifier path is exercised. The
+extra kind ``fatal`` injects an unclassifiable error (test harness for
+crash/resume).
+
+**Phase checkpoints** (``DELPHI_CHECKPOINT_DIR`` / ``repair.checkpoint.dir``):
+:class:`PhaseCheckpointStore` persists fingerprinted per-phase outputs
+(detected error cells, trained model blobs) atomically (tmp +
+``os.replace``) after each phase, so a crashed or killed run resumes at the
+last completed phase; the PR 2 stall watchdog routes through
+:func:`on_watchdog_stall` to request a safe abort (the last completed
+phase's checkpoint is already on disk) instead of only dumping stacks.
+"""
+
+import fnmatch
+import json
+import logging
+import os
+import pickle
+import re
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from delphi_tpu.observability import counter_inc, histogram_observe
+from delphi_tpu.observability.provenance import active_ledger
+from delphi_tpu.observability.spans import current_recorder
+
+_logger = logging.getLogger(__name__)
+
+_FALSY = frozenset({"0", "false", "no", "off"})
+
+# -- fault taxonomy ----------------------------------------------------------
+
+KIND_INIT_TIMEOUT = "init_timeout"
+KIND_OOM = "oom"
+KIND_TRANSFER = "transfer"
+KIND_COMPILE = "compile"
+KIND_TRANSIENT = "transient"
+FAULT_KINDS = (KIND_INIT_TIMEOUT, KIND_OOM, KIND_TRANSFER, KIND_COMPILE,
+               KIND_TRANSIENT)
+
+
+class BackendInitTimeout(RuntimeError):
+    """The backend-init probe hit its hard deadline (the hanging-TPU-init
+    failure mode): raised instead of stalling the run forever."""
+
+
+class FaultInjected(BaseException):
+    """An exception injected by the DELPHI_FAULT_PLAN harness. The message
+    mimics the real runtime's error text so classify_fault exercises the
+    production patterns, not a test-only shortcut.
+
+    Derives from BaseException so an injected fault that run_guarded cannot
+    absorb (kind ``fatal``, or a plan that exhausts the whole ladder) kills
+    the run like a real crash would, instead of being masked by the
+    pipeline's ``except Exception`` degradation fallbacks — the chaos A/B
+    bit-identity check depends on injected faults surfacing loudly."""
+
+    def __init__(self, kind: str, site: str, n: int) -> None:
+        self.kind = kind
+        super().__init__(_INJECT_MESSAGES.get(kind, _INJECT_MESSAGES["fatal"])
+                         .format(site=site, n=n))
+
+
+class ShrinkBatch(Exception):
+    """Degradation signal OUT of run_guarded: the OOM ladder chose 'shrink'.
+    The call site catches it, halves its padded batch, and re-invokes the
+    guarded launch on each half (bit-identical: every launch route assembles
+    per-piece results, so the split changes launch count, not values)."""
+
+
+class RunAborted(BaseException):
+    """Raised at the next guarded seam entry / phase boundary after
+    request_abort — the stall watchdog's checkpoint-and-abort path.
+
+    BaseException, not Exception: an abort must terminate the run at the
+    next checkpoint, not be converted into "fall back to the sequential
+    path" by a catch-all in the training pipeline."""
+
+
+_INJECT_MESSAGES = {
+    KIND_OOM: ("RESOURCE_EXHAUSTED: out of memory while trying to allocate "
+               "buffer (injected at {site} call {n})"),
+    KIND_INIT_TIMEOUT: ("DEADLINE_EXCEEDED: backend initialization timed "
+                        "out (injected at {site} call {n})"),
+    KIND_TRANSFER: ("INTERNAL: failed to transfer buffer to device "
+                    "(injected at {site} call {n})"),
+    KIND_COMPILE: ("INVALID_ARGUMENT: XLA compilation failed for module "
+                   "(injected at {site} call {n})"),
+    KIND_TRANSIENT: ("UNAVAILABLE: connection to coordination service "
+                     "lost (injected at {site} call {n})"),
+    "fatal": "injected unclassifiable fault at {site} call {n}",
+}
+
+# Case-sensitive gRPC/XLA status codes; lower-case word patterns matched
+# case-insensitively below. Order matters: the first matching kind wins, and
+# the more specific kinds (init, oom, transfer) outrank the generic
+# transient codes that often share a message.
+_CODE_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = (
+    (KIND_OOM, re.compile(r"RESOURCE_EXHAUSTED")),
+    (KIND_TRANSIENT, re.compile(r"UNAVAILABLE|ABORTED|DATA_LOSS"
+                                r"|INTERNAL: RecvBuf|INTERNAL: Failed to "
+                                r"complete all kernels")),
+)
+_WORD_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = (
+    (KIND_INIT_TIMEOUT, re.compile(
+        r"backend.{0,40}init\w*.{0,40}(timed out|timeout|deadline)"
+        r"|init\w*.{0,40}(timed out|deadline exceeded)"
+        r"|deadline_exceeded.{0,60}init", re.IGNORECASE | re.DOTALL)),
+    (KIND_OOM, re.compile(
+        r"out of memory|\boom\b|exhausted|failed to allocate"
+        r"|allocation.{0,30}(failed|exceed)|hbm.{0,30}exceed",
+        re.IGNORECASE | re.DOTALL)),
+    (KIND_TRANSFER, re.compile(
+        r"failed to transfer|transfer.{0,30}(buffer|failed|error)"
+        r"|copy.{0,20}to device|TransferTo\w+|device buffer.{0,20}"
+        r"(lost|invalid|deleted)", re.IGNORECASE | re.DOTALL)),
+    (KIND_COMPILE, re.compile(
+        r"compil\w+.{0,30}(failed|error)|failed.{0,30}compil"
+        r"|xla.{0,30}lower|lowering.{0,20}(failed|error)|mosaic",
+        re.IGNORECASE | re.DOTALL)),
+    (KIND_TRANSIENT, re.compile(
+        r"connection (reset|refused|closed)|socket closed|broken pipe"
+        r"|temporarily unavailable|try again", re.IGNORECASE | re.DOTALL)),
+)
+
+
+def classify_fault(exc: BaseException) -> Optional[str]:
+    """Maps an exception to a fault kind, or None for unclassifiable
+    failures (program bugs, bad input) that must re-raise unretried. The
+    resilience plane's own control-flow exceptions are never faults."""
+    if isinstance(exc, (ShrinkBatch, RunAborted)):
+        return None
+    if isinstance(exc, BackendInitTimeout):
+        return KIND_INIT_TIMEOUT
+    msg = f"{type(exc).__name__}: {exc}"
+    for kind, pat in _WORD_PATTERNS[:1]:  # init_timeout outranks the codes
+        if pat.search(msg):
+            return kind
+    for kind, pat in _CODE_PATTERNS:
+        if pat.search(msg):
+            return kind
+    for kind, pat in _WORD_PATTERNS[1:]:
+        if pat.search(msg):
+            return kind
+    return None
+
+
+# -- retry policy ------------------------------------------------------------
+
+_RETRY_CAP_S = 5.0
+
+
+def _env_or_conf(env: str, conf_key: str, cast, default):
+    raw = os.environ.get(env)
+    if raw is None or not raw.strip():
+        from delphi_tpu.session import get_session
+        raw = get_session().conf.get(conf_key)
+        if raw is None or not str(raw).strip():
+            return default
+    try:
+        return cast(str(raw).strip())
+    except (TypeError, ValueError):
+        _logger.warning(f"{env}/{conf_key}: unparsable value {raw!r}, "
+                        f"using default {default!r}")
+        return default
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with DETERMINISTIC jitter: the delay for
+    (site, attempt) is a pure function — crc32-derived fraction, no RNG —
+    so a replayed run with the same fault plan sleeps the same schedule and
+    the fake-clock tests can assert it exactly."""
+
+    def __init__(self, max_retries: int = 2, base_s: float = 0.05,
+                 cap_s: float = _RETRY_CAP_S) -> None:
+        self.max_retries = max(0, int(max_retries))
+        self.base_s = max(0.0, float(base_s))
+        self.cap_s = max(self.base_s, float(cap_s))
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        base = min(self.cap_s, self.base_s * (2 ** max(attempt - 1, 0)))
+        frac = (zlib.crc32(f"{site}:{attempt}".encode()) % 1024) / 1024.0
+        return round(base * (0.5 + 0.5 * frac), 6)
+
+
+def default_policy() -> RetryPolicy:
+    """The process-wide policy: ``DELPHI_RETRY_MAX`` retries per guarded
+    call (default 2) starting at ``DELPHI_RETRY_BASE_S`` seconds (default
+    0.05), doubling up to a 5 s cap; session-config fallbacks
+    ``repair.resilience.retry_max`` / ``repair.resilience.retry_base_s``."""
+    return RetryPolicy(
+        max_retries=_env_or_conf("DELPHI_RETRY_MAX",
+                                 "repair.resilience.retry_max", int, 2),
+        base_s=_env_or_conf("DELPHI_RETRY_BASE_S",
+                            "repair.resilience.retry_base_s", float, 0.05))
+
+
+# -- fault injection ---------------------------------------------------------
+
+_PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")
+
+
+def parse_fault_plan(text: str):
+    """``site:nth:kind`` triples, comma-separated. ``site`` is an fnmatch
+    pattern over guarded-seam site names; ``nth`` is the 1-based seam-entry
+    count for that site (attempts count, so consecutive ``nth`` values hit
+    consecutive retries); ``kind`` is a taxonomy kind or ``fatal``."""
+    triples = []
+    for part in text.split(","):
+        if not part.strip():
+            continue
+        m = _PLAN_RE.match(part)
+        if not m:
+            raise ValueError(
+                f"DELPHI_FAULT_PLAN: bad triple {part!r} "
+                "(expected site:nth:kind)")
+        pat, nth, kind = m.group(1), int(m.group(2)), m.group(3)
+        if kind not in FAULT_KINDS and kind != "fatal":
+            raise ValueError(
+                f"DELPHI_FAULT_PLAN: unknown fault kind {kind!r} "
+                f"(one of {', '.join(FAULT_KINDS)}, fatal)")
+        if nth < 1:
+            raise ValueError("DELPHI_FAULT_PLAN: nth is 1-based")
+        triples.append((pat, nth, kind))
+    return tuple(triples)
+
+
+def _fault_plan_text() -> str:
+    env = os.environ.get("DELPHI_FAULT_PLAN")
+    if env is not None:
+        return env
+    from delphi_tpu.session import get_session
+    conf = get_session().conf.get("repair.fault.plan")
+    return str(conf) if conf else ""
+
+
+_plan_lock = threading.Lock()
+_plan_state: Dict[str, Any] = {"text": None, "triples": (), "fired": set(),
+                               "calls": {}}
+
+
+def reset_fault_state() -> None:
+    """Forgets fired triples and per-site call counts (tests / benches that
+    replay the same plan in one process)."""
+    with _plan_lock:
+        _plan_state.update(text=None, triples=(), fired=set(), calls={})
+
+
+def _maybe_inject(site: str) -> None:
+    text = _fault_plan_text()
+    with _plan_lock:
+        if text != _plan_state["text"]:
+            _plan_state.update(text=text,
+                               triples=parse_fault_plan(text) if text else (),
+                               fired=set(), calls={})
+        triples = _plan_state["triples"]
+        if not triples:
+            return
+        n = _plan_state["calls"].get(site, 0) + 1
+        _plan_state["calls"][site] = n
+        hit = None
+        for i, (pat, nth, kind) in enumerate(triples):
+            if i in _plan_state["fired"]:
+                continue
+            if nth == n and fnmatch.fnmatchcase(site, pat):
+                _plan_state["fired"].add(i)
+                hit = (kind, n)
+                break
+    if hit is not None:
+        counter_inc("resilience.injected")
+        _logger.warning(f"fault plan: injecting {hit[0]} at {site} "
+                        f"(call {hit[1]})")
+        raise FaultInjected(hit[0], site, hit[1])
+
+
+# -- CPU fallback latch ------------------------------------------------------
+
+_cpu_latch: Dict[str, Any] = {"active": False, "phase": None, "site": None}
+
+
+def _current_phase() -> Optional[str]:
+    rec = current_recorder()
+    return getattr(rec, "current_phase", None) if rec is not None else None
+
+
+def cpu_fallback_active() -> bool:
+    """True while the repeated-device-fault CPU latch holds. Scoped to the
+    phase that latched it: the latch self-clears when the recorder's current
+    phase moves on (the next phase gets the device back); without a recorder
+    it holds until clear_cpu_fallback()."""
+    if not _cpu_latch["active"]:
+        return False
+    phase = _current_phase()
+    if phase is not None and _cpu_latch["phase"] is not None \
+            and phase != _cpu_latch["phase"]:
+        clear_cpu_fallback()
+        return False
+    return True
+
+
+def clear_cpu_fallback() -> None:
+    _cpu_latch.update(active=False, phase=None, site=None)
+
+
+def _latch_cpu_fallback(site: str) -> bool:
+    import jax
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:
+        return False
+    _cpu_latch.update(active=True, phase=_current_phase(), site=site,
+                      device=cpu)
+    return True
+
+
+def _cpu_device():
+    import jax
+    return jax.default_device(_cpu_latch.get("device")
+                              or jax.devices("cpu")[0])
+
+
+# -- abort (watchdog checkpoint-and-abort) -----------------------------------
+
+_abort_state: Dict[str, Optional[str]] = {"reason": None}
+
+
+def request_abort(reason: str) -> None:
+    """Arms the abort latch: the run raises RunAborted at the next guarded
+    seam entry or phase boundary — a SAFE stop, because phase checkpoints
+    persist at phase end, never mid-phase."""
+    if _abort_state["reason"] is None:
+        _abort_state["reason"] = str(reason)
+        counter_inc("resilience.aborts_requested")
+
+
+def abort_requested() -> Optional[str]:
+    return _abort_state["reason"]
+
+
+def clear_abort() -> None:
+    _abort_state["reason"] = None
+
+
+def maybe_abort() -> None:
+    reason = _abort_state["reason"]
+    if reason is not None:
+        raise RunAborted(f"run aborted: {reason}")
+
+
+def on_watchdog_stall(recorder: Any, idle_s: float) -> None:
+    """The stall watchdog's checkpoint-and-abort hook. Armed when a
+    checkpoint dir is configured (resume is safe) or ``DELPHI_STALL_ABORT``
+    is explicitly truthy; an explicitly falsy flag disables it even with a
+    checkpoint dir, restoring the PR 2 dump-stacks-only behavior."""
+    flag = os.environ.get("DELPHI_STALL_ABORT")
+    directory = checkpoint_dir()
+    if flag is not None and flag.strip():
+        enabled = flag.strip().lower() not in _FALSY
+    else:
+        enabled = directory is not None
+    if not enabled:
+        return
+    counter_inc("resilience.stall_aborts")
+    request_abort(f"watchdog stall: no span transition for {idle_s:.1f}s")
+    if directory:
+        try:
+            os.makedirs(directory, exist_ok=True)
+            marker = os.path.join(directory, "stall_abort.json")
+            with open(marker, "w") as f:
+                json.dump({"idle_s": round(idle_s, 3),
+                           "active_spans": recorder.active_spans(),
+                           "transition_count": recorder.transition_count},
+                          f)
+        except Exception as e:  # marker is best-effort evidence
+            _logger.warning(f"failed to write stall marker: {e}")
+
+
+# -- the guarded seam --------------------------------------------------------
+
+def _stamp_ledger(action: str, site: str, kind: str) -> None:
+    led = active_ledger()
+    if led is not None:
+        record = getattr(led, "record_note", None)
+        if record is not None:
+            record(f"resilience.{action}", f"{site}: {kind}")
+
+
+def run_guarded(site: str, thunk: Callable[[], Any], *,
+                can_shrink: bool = False,
+                evict: Optional[Callable[[], Any]] = None,
+                cpu_fallback: bool = True,
+                policy: Optional[RetryPolicy] = None,
+                sleep: Optional[Callable[[float], None]] = None,
+                classify: Callable[[BaseException], Optional[str]]
+                = classify_fault) -> Any:
+    """Runs one device launch/upload under the resilience plane. See the
+    module docstring for the retry + degradation-ladder contract. ``sleep``
+    is injectable so tier-1 tests run the schedule against a fake clock."""
+    pol = policy or default_policy()
+    do_sleep = sleep if sleep is not None else time.sleep
+    maybe_abort()
+    attempt = 0
+    budget = pol.max_retries
+    evicted = False
+    while True:
+        attempt += 1
+        try:
+            _maybe_inject(site)
+            if cpu_fallback_active():
+                with _cpu_device():
+                    return thunk()
+            return thunk()
+        except (ShrinkBatch, RunAborted):
+            raise
+        except (Exception, FaultInjected) as exc:
+            kind = classify(exc)
+            if kind is None:
+                raise
+            counter_inc(f"resilience.faults.{kind}")
+            _logger.warning(
+                f"{site}: classified {kind} fault on attempt {attempt}: "
+                f"{type(exc).__name__}: {exc}")
+            if budget > 0:
+                budget -= 1
+                delay = pol.backoff_s(site, attempt)
+                counter_inc("resilience.retries")
+                histogram_observe("resilience.backoff_seconds", delay)
+                do_sleep(delay)
+                continue
+            # retry budget exhausted: walk the degradation ladder
+            # (shrink -> evict -> CPU fallback), cheapest escalation first
+            if can_shrink:
+                counter_inc("resilience.degrade.shrink")
+                _stamp_ledger("shrink", site, kind)
+                _logger.warning(f"{site}: degrading — shrink batch ({kind})")
+                raise ShrinkBatch(site) from exc
+            if evict is not None and not evicted:
+                evicted = True
+                counter_inc("resilience.degrade.evict")
+                _stamp_ledger("evict", site, kind)
+                _logger.warning(
+                    f"{site}: degrading — evicting device residency and "
+                    f"re-uploading ({kind})")
+                evict()
+                budget = pol.max_retries
+                continue
+            if cpu_fallback and not _cpu_latch["active"] \
+                    and _latch_cpu_fallback(site):
+                counter_inc("resilience.degrade.cpu_fallback")
+                _stamp_ledger("cpu_fallback", site, kind)
+                _logger.warning(
+                    f"{site}: degrading — CPU backend for the remainder "
+                    f"of the phase ({kind})")
+                budget = pol.max_retries
+                continue
+            raise
+
+
+# -- backend-init hard-deadline probe ----------------------------------------
+
+def init_deadline_s() -> float:
+    """Hard deadline for the backend-init probe in seconds:
+    ``DELPHI_INIT_DEADLINE_S`` / ``repair.init.deadline_s`` (default 180;
+    0 disables and probes inline with no deadline)."""
+    return _env_or_conf("DELPHI_INIT_DEADLINE_S", "repair.init.deadline_s",
+                        float, 180.0)
+
+
+def probe_backend(deadline_s: Optional[float] = None,
+                  probe: Optional[Callable[[], Any]] = None):
+    """``jax.devices()`` under a hard deadline, probed from a daemon thread:
+    a hanging TPU init (the BENCH_TPU_MEASURED.md failure mode) raises
+    :class:`BackendInitTimeout` within the deadline instead of stalling the
+    run — the caller degrades to the single-device/CPU path. The wedged
+    probe thread is daemonic and leaks by design (it cannot be cancelled);
+    ``probe`` is injectable for tests."""
+    deadline = init_deadline_s() if deadline_s is None else float(deadline_s)
+    _maybe_inject("backend.init")
+
+    def _probe():
+        import jax
+        return jax.devices()
+
+    fn = probe if probe is not None else _probe
+    if deadline <= 0:
+        return fn()
+    out: Dict[str, Any] = {}
+
+    def work():
+        try:
+            out["devices"] = fn()
+        except BaseException as e:  # pragma: no cover - backend specific
+            out["error"] = e
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="delphi-backend-probe")
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        raise BackendInitTimeout(
+            f"backend initialization timed out after {deadline:.1f}s "
+            "(DELPHI_INIT_DEADLINE_S hard deadline); degrading")
+    if "error" in out:
+        raise out["error"]
+    return out["devices"]
+
+
+def note_fault(exc: BaseException, site: str) -> Optional[str]:
+    """Classifies and counts a fault handled OUTSIDE run_guarded (e.g. the
+    mesh probe, whose retry-after policy predates this plane). Returns the
+    kind, or None when unclassifiable."""
+    kind = classify_fault(exc)
+    if kind is not None:
+        counter_inc(f"resilience.faults.{kind}")
+        _logger.warning(f"{site}: classified {kind} fault: "
+                        f"{type(exc).__name__}: {exc}")
+    return kind
+
+
+# -- phase checkpoint store --------------------------------------------------
+
+def checkpoint_dir() -> Optional[str]:
+    """``DELPHI_CHECKPOINT_DIR`` / ``repair.checkpoint.dir``, or None when
+    run-level phase checkpointing is off (the default)."""
+    env = os.environ.get("DELPHI_CHECKPOINT_DIR")
+    if env is not None and env.strip():
+        return env.strip()
+    from delphi_tpu.session import get_session
+    conf = get_session().conf.get("repair.checkpoint.dir")
+    return str(conf).strip() if conf and str(conf).strip() else None
+
+
+_PHASE_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+class PhaseCheckpointStore:
+    """Fingerprinted per-phase pickles under one directory. Same trust
+    boundary as the model checkpoint (model.py): checkpoints are plain
+    pickles — point the directory only at files this process (or you)
+    wrote. Writes are atomic (tmp + ``os.replace`` + fsync), so a kill
+    mid-save leaves the previous checkpoint intact."""
+
+    VERSION = 1
+
+    def __init__(self, directory: str, fingerprint: Dict[str, Any]) -> None:
+        self.directory = directory
+        self.fingerprint = fingerprint
+
+    def _path(self, phase: str) -> str:
+        return os.path.join(self.directory,
+                            f"phase_{_PHASE_SAFE.sub('_', phase)}.pkl")
+
+    def load(self, phase: str) -> Optional[Any]:
+        path = self._path(phase)
+        if not os.path.exists(path):
+            counter_inc("resilience.checkpoint.misses")
+            return None
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception as e:
+            _logger.warning(f"Ignoring unreadable phase checkpoint "
+                            f"{path}: {e}")
+            counter_inc("resilience.checkpoint.misses")
+            return None
+        if not isinstance(payload, dict) \
+                or payload.get("version") != self.VERSION \
+                or payload.get("fingerprint") != self.fingerprint:
+            _logger.warning(
+                f"Ignoring stale phase checkpoint {path}: input/options "
+                "changed since it was written")
+            counter_inc("resilience.checkpoint.stale")
+            return None
+        counter_inc("resilience.checkpoint.hits")
+        _logger.info(f"Resuming phase '{phase}' from checkpoint {path}")
+        return payload["payload"]
+
+    def save(self, phase: str, payload: Any) -> None:
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(prefix=f".phase_{phase}_",
+                                       dir=self.directory)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump({"version": self.VERSION,
+                                 "fingerprint": self.fingerprint,
+                                 "phase": phase,
+                                 "payload": payload}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path(phase))
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            counter_inc("resilience.checkpoint.saves")
+            _logger.info(
+                f"Phase '{phase}' checkpointed to {self._path(phase)}")
+        except Exception as e:
+            # a failed checkpoint write must never fail the run itself
+            _logger.warning(f"Failed to write phase checkpoint for "
+                            f"'{phase}': {e}")

@@ -597,3 +597,50 @@ def test_repair_validation_enabled_end_to_end(session):
     repaired_cells = set(zip(out["tid"], out["attribute"]))
     assert not (flagged & repaired_cells), \
         f"surviving repairs still violate: {sorted(flagged & repaired_cells)}"
+
+
+def test_validate_repairs_keeps_repairs_beside_preexisting_violations(session):
+    """Recall regression (ADVICE round 5): validation must drop only the
+    candidates that INTRODUCE a violation. A correct repair landing in a
+    group that already contains an undetected violation among the clean
+    rows must survive — the violation existed before the repair, so the
+    before/after diff (4-arg call with the original dirty rows) exonerates
+    it, while the legacy 3-arg call conservatively drops every
+    after-violation."""
+    clean = pd.DataFrame({
+        "tid": ["1", "2", "3", "6"],
+        "City": ["ba", "ba", "ba", "bb"],
+        # tid 3 is an UNDETECTED violation among the clean rows: City ba
+        # maps to both x and z no matter what any repair does
+        "State": ["x", "x", "z", "y"]})
+    original = pd.DataFrame({
+        "tid": ["4", "5"],
+        "City": ["ba", "bb"],
+        "State": ["z", "y"]})
+    repaired = pd.DataFrame({
+        "tid": ["4", "5"],
+        "City": ["ba", "bb"],
+        # tid 4: correct repair z->x (already violated before via tid 3);
+        # tid 5: bad repair y->w introduces a NEW violation against tid 6
+        "State": ["x", "w"]})
+    candidates = pd.DataFrame({
+        "tid": ["4", "5"],
+        "attribute": ["State", "State"],
+        "current_value": ["z", "y"],
+        "repaired": ["x", "w"]})
+
+    session.register(
+        "vtab3", pd.concat([clean, original], ignore_index=True))
+    m = delphi.repair.setInput("vtab3").setRowId("tid").setErrorDetectors([
+        ConstraintErrorDetector(
+            constraints="t1&t2&EQ(t1.City,t2.City)&IQ(t1.State,t2.State)")])
+
+    out = m._validate_repairs(candidates, repaired, clean, original)
+    assert out["tid"].tolist() == ["4"], \
+        "a repair beside a pre-existing violation must survive; one that " \
+        "introduces a violation must drop"
+
+    # legacy behavior (no original rows): every after-violation drops,
+    # including the correct repair — the recall loss this fix removes
+    legacy = m._validate_repairs(candidates, repaired, clean)
+    assert legacy["tid"].tolist() == []

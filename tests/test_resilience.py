@@ -1,0 +1,415 @@
+"""Resilience plane tests: fault taxonomy, deterministic backoff, the
+run_guarded retry + degradation ladder (against a fake clock — no real
+sleeps), the DELPHI_FAULT_PLAN injection harness, the phase checkpoint
+store, the backend-init deadline probe, and crash/resume bit-identity."""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu.parallel import resilience as rz
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Every test starts and ends with no latched state and no plan."""
+    for var in ("DELPHI_FAULT_PLAN", "DELPHI_RETRY_MAX",
+                "DELPHI_RETRY_BASE_S", "DELPHI_CHECKPOINT_DIR",
+                "DELPHI_STALL_ABORT", "DELPHI_INIT_DEADLINE_S"):
+        os.environ.pop(var, None)
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+    yield
+    for var in ("DELPHI_FAULT_PLAN", "DELPHI_RETRY_MAX",
+                "DELPHI_RETRY_BASE_S", "DELPHI_CHECKPOINT_DIR",
+                "DELPHI_STALL_ABORT", "DELPHI_INIT_DEADLINE_S"):
+        os.environ.pop(var, None)
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+
+
+# -- classification -----------------------------------------------------------
+
+@pytest.mark.parametrize("exc,kind", [
+    # realistic runtime texts, per taxonomy kind
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory while trying to "
+                  "allocate 2147483648 bytes"), "oom"),
+    (RuntimeError("XlaRuntimeError: RESOURCE_EXHAUSTED: Error allocating "
+                  "device buffer"), "oom"),
+    (RuntimeError("Allocation of 4096 exceeds free HBM memory"), "oom"),
+    (RuntimeError("INTERNAL: failed to transfer buffer to device 0"),
+     "transfer"),
+    (RuntimeError("TransferToDeviceStream failed"), "transfer"),
+    (RuntimeError("UNAVAILABLE: connection to coordination service lost"),
+     "transient"),
+    (ConnectionError("connection reset by peer"), "transient"),
+    (RuntimeError("INVALID_ARGUMENT: XLA compilation failed for module "
+                  "jit_kernel"), "compile"),
+    (RuntimeError("Mosaic lowering failed"), "compile"),
+    (RuntimeError("DEADLINE_EXCEEDED: backend initialization timed out"),
+     "init_timeout"),
+    (rz.BackendInitTimeout("backend initialization timed out after 1.0s"),
+     "init_timeout"),
+    # unclassifiable = program bugs: never retried
+    (ValueError("bad shape (3, 4)"), None),
+    (KeyError("attr"), None),
+    (RuntimeError("something else entirely"), None),
+    # the plane's own control-flow exceptions are never faults
+    (rz.ShrinkBatch("domain.bucket"), None),
+    (rz.RunAborted("run aborted: watchdog"), None),
+])
+def test_classify_fault(exc, kind):
+    assert rz.classify_fault(exc) == kind
+
+
+def test_injected_faults_classify_as_their_kind():
+    # the injector's messages must exercise the REAL classifier patterns
+    for kind in rz.FAULT_KINDS:
+        exc = rz.FaultInjected(kind, "some.site", 1)
+        assert rz.classify_fault(exc) == kind, kind
+    assert rz.classify_fault(rz.FaultInjected("fatal", "some.site", 1)) is None
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_backoff_is_deterministic_bounded_and_exponential():
+    pol = rz.RetryPolicy(max_retries=4, base_s=0.1, cap_s=1.0)
+    sched = [pol.backoff_s("site.a", i) for i in range(1, 6)]
+    assert sched == [pol.backoff_s("site.a", i) for i in range(1, 6)], \
+        "same (site, attempt) must give the same delay"
+    for i, d in enumerate(sched, start=1):
+        base = min(1.0, 0.1 * 2 ** (i - 1))
+        assert 0.5 * base <= d <= base, (i, d)
+    # different sites jitter differently (crc32 seeds differ)
+    assert [pol.backoff_s("site.b", i) for i in range(1, 6)] != sched
+
+
+def test_default_policy_env_overrides():
+    os.environ["DELPHI_RETRY_MAX"] = "7"
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.25"
+    pol = rz.default_policy()
+    assert pol.max_retries == 7
+    assert pol.base_s == 0.25
+    os.environ["DELPHI_RETRY_MAX"] = "not a number"
+    assert rz.default_policy().max_retries == 2  # unparsable -> default
+
+
+# -- fault plan ---------------------------------------------------------------
+
+def test_parse_fault_plan():
+    plan = rz.parse_fault_plan(
+        "backend.init:1:init_timeout, domain.*:3:oom ,xfer.upload:2:fatal")
+    assert plan == (("backend.init", 1, "init_timeout"),
+                    ("domain.*", 3, "oom"), ("xfer.upload", 2, "fatal"))
+    with pytest.raises(ValueError, match="bad triple"):
+        rz.parse_fault_plan("no-colons-here")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        rz.parse_fault_plan("site:1:meltdown")
+    with pytest.raises(ValueError, match="1-based"):
+        rz.parse_fault_plan("site:0:oom")
+
+
+def test_injection_counts_site_entries_and_fires_once():
+    os.environ["DELPHI_FAULT_PLAN"] = "domain.*:2:oom"
+    rz._maybe_inject("domain.bucket")  # entry 1: no fire
+    with pytest.raises(rz.FaultInjected) as ei:
+        rz._maybe_inject("domain.bucket")  # entry 2: fires
+    assert rz.classify_fault(ei.value) == "oom"
+    rz._maybe_inject("domain.bucket")  # fired already: never again
+    rz._maybe_inject("other.site")  # pattern mismatch: no fire
+
+
+# -- run_guarded: retry + degradation ladder ----------------------------------
+
+def _fake_clock():
+    slept = []
+    return slept, slept.append
+
+
+def test_run_guarded_retries_injected_fault_with_exact_backoff():
+    os.environ["DELPHI_FAULT_PLAN"] = "s:1:transient,s:2:transient"
+    slept, sleep = _fake_clock()
+    calls = []
+    pol = rz.RetryPolicy(max_retries=2, base_s=0.05)
+    out = rz.run_guarded("s", lambda: calls.append(1) or 41 + 1,
+                         policy=pol, sleep=sleep)
+    assert out == 42
+    assert len(calls) == 1  # two injections fired BEFORE the thunk ran
+    assert slept == [pol.backoff_s("s", 1), pol.backoff_s("s", 2)]
+
+
+def test_run_guarded_reraises_unclassifiable_immediately():
+    slept, sleep = _fake_clock()
+    attempts = []
+
+    def thunk():
+        attempts.append(1)
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        rz.run_guarded("s", thunk, sleep=sleep)
+    assert len(attempts) == 1 and slept == []
+
+
+def test_run_guarded_ladder_order_shrink_then_evict_then_cpu():
+    events = []
+
+    def thunk():
+        events.append("attempt")
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    slept, sleep = _fake_clock()
+    pol = rz.RetryPolicy(max_retries=1, base_s=0.0)
+
+    # rung 1: shrink outranks everything when the caller can split
+    with pytest.raises(rz.ShrinkBatch):
+        rz.run_guarded("s", thunk, can_shrink=True,
+                       evict=lambda: events.append("evict"),
+                       policy=pol, sleep=sleep)
+    assert events == ["attempt", "attempt"]  # 1 try + 1 retry, no evict
+
+    # rungs 2+3: evict (budget resets), then CPU latch (budget resets),
+    # then re-raise once every rung is spent
+    events.clear()
+    with pytest.raises(RuntimeError):
+        rz.run_guarded("s", thunk, evict=lambda: events.append("evict"),
+                       policy=pol, sleep=sleep)
+    assert events == ["attempt", "attempt", "evict",
+                      "attempt", "attempt",  # post-evict retry cycle
+                      "attempt", "attempt"]  # post-cpu-latch retry cycle
+    assert rz.cpu_fallback_active()
+
+
+def test_cpu_fallback_latch_is_phase_scoped():
+    assert rz._latch_cpu_fallback("s")
+    assert rz.cpu_fallback_active()  # no recorder: holds until cleared
+    rz.clear_cpu_fallback()
+    assert not rz.cpu_fallback_active()
+
+
+def test_run_guarded_raises_run_aborted_at_entry():
+    rz.request_abort("watchdog stall")
+    with pytest.raises(rz.RunAborted):
+        rz.run_guarded("s", lambda: 1)
+    rz.clear_abort()
+    assert rz.run_guarded("s", lambda: 1) == 1
+
+
+# -- watchdog checkpoint-and-abort --------------------------------------------
+
+class _FakeRecorder:
+    current_phase = "training"
+    transition_count = 7
+
+    def active_spans(self):
+        return ["repair.run", "training"]
+
+
+def test_on_watchdog_stall_writes_marker_and_arms_abort(tmp_path):
+    os.environ["DELPHI_CHECKPOINT_DIR"] = str(tmp_path)
+    rz.on_watchdog_stall(_FakeRecorder(), 123.4)
+    assert rz.abort_requested() is not None
+    marker = tmp_path / "stall_abort.json"
+    assert marker.is_file()
+    import json
+    data = json.loads(marker.read_text())
+    assert data["idle_s"] == 123.4 and data["transition_count"] == 7
+
+
+def test_on_watchdog_stall_disabled_without_dir_or_flag():
+    rz.on_watchdog_stall(_FakeRecorder(), 99.0)
+    assert rz.abort_requested() is None
+
+
+def test_stall_abort_flag_overrides(tmp_path):
+    # explicit falsy flag disables even with a checkpoint dir
+    os.environ["DELPHI_CHECKPOINT_DIR"] = str(tmp_path)
+    os.environ["DELPHI_STALL_ABORT"] = "0"
+    rz.on_watchdog_stall(_FakeRecorder(), 99.0)
+    assert rz.abort_requested() is None
+    # explicit truthy flag enables even without a dir
+    os.environ.pop("DELPHI_CHECKPOINT_DIR")
+    os.environ["DELPHI_STALL_ABORT"] = "1"
+    rz.on_watchdog_stall(_FakeRecorder(), 99.0)
+    assert rz.abort_requested() is not None
+
+
+# -- backend-init probe -------------------------------------------------------
+
+def test_probe_backend_times_out_on_wedged_probe():
+    with pytest.raises(rz.BackendInitTimeout):
+        rz.probe_backend(deadline_s=0.05, probe=lambda: time.sleep(10))
+
+
+def test_probe_backend_returns_devices_and_propagates_errors():
+    assert rz.probe_backend(deadline_s=5.0, probe=lambda: ["dev0"]) == ["dev0"]
+
+    def broken():
+        raise RuntimeError("UNAVAILABLE: tunnel down")
+
+    with pytest.raises(RuntimeError, match="tunnel down"):
+        rz.probe_backend(deadline_s=5.0, probe=broken)
+    # deadline 0 disables the thread entirely
+    assert rz.probe_backend(deadline_s=0, probe=lambda: ["dev0"]) == ["dev0"]
+
+
+def test_probe_backend_honors_fault_plan():
+    os.environ["DELPHI_FAULT_PLAN"] = "backend.init:1:init_timeout"
+    with pytest.raises(rz.FaultInjected) as ei:
+        rz.probe_backend(deadline_s=5.0, probe=lambda: ["dev0"])
+    assert rz.classify_fault(ei.value) == "init_timeout"
+    # the triple fired once: the probe now succeeds
+    assert rz.probe_backend(deadline_s=5.0, probe=lambda: ["dev0"]) == ["dev0"]
+
+
+# -- phase checkpoint store ---------------------------------------------------
+
+def test_phase_checkpoint_roundtrip_and_stale_fingerprint(tmp_path):
+    store = rz.PhaseCheckpointStore(str(tmp_path), {"content": "abc"})
+    assert store.load("detect") is None  # miss
+    payload = {"cells": pd.DataFrame({"a": [1, 2]}), "stats": np.arange(3)}
+    store.save("detect", payload)
+    loaded = store.load("detect")
+    pd.testing.assert_frame_equal(loaded["cells"], payload["cells"])
+    np.testing.assert_array_equal(loaded["stats"], payload["stats"])
+
+    # a different fingerprint (edited input/options) must refuse the file
+    stale = rz.PhaseCheckpointStore(str(tmp_path), {"content": "xyz"})
+    assert stale.load("detect") is None
+
+
+def test_phase_checkpoint_ignores_corrupt_and_wrong_version_files(tmp_path):
+    store = rz.PhaseCheckpointStore(str(tmp_path), {"content": "abc"})
+    path = tmp_path / "phase_detect.pkl"
+    path.write_bytes(b"not a pickle")
+    assert store.load("detect") is None
+    with open(path, "wb") as f:
+        pickle.dump({"version": 999, "fingerprint": {"content": "abc"},
+                     "payload": 1}, f)
+    assert store.load("detect") is None
+
+
+def test_phase_checkpoint_save_never_raises(tmp_path):
+    # an unwritable directory must degrade to a warning, not fail the run
+    store = rz.PhaseCheckpointStore(
+        str(tmp_path / "no" / "\0bad"), {"content": "abc"})
+    store.save("detect", {"x": 1})
+
+
+# -- end-to-end: crash mid-run, resume bit-identical --------------------------
+
+def _tiny_repair(name, df, session):
+    from delphi_tpu import delphi
+    from delphi_tpu.errors import NullErrorDetector
+
+    session.register(name, df.copy())
+    try:
+        return delphi.repair \
+            .setTableName(name) \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]) \
+            .run()
+    finally:
+        session.drop(name)
+
+
+def test_checkpoint_resume_bit_identical_after_fatal_mid_run(
+        tmp_path, session):
+    """The acceptance scenario: a run killed between phases (here by an
+    injected unclassifiable fault during training) resumes from
+    DELPHI_CHECKPOINT_DIR and produces the same final frame as an
+    uninterrupted run."""
+    rng = np.random.RandomState(0)
+    n = 64
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": rng.choice(["a", "b"], n),
+        "c1": rng.choice(["p", "q", "r"], n),
+        "c2": rng.choice(["0", "1", "2", "3"], n),
+    })
+    df.loc[df.index % 9 == 0, "c1"] = None
+
+    baseline = _tiny_repair("rz_base", df, session)
+
+    os.environ["DELPHI_CHECKPOINT_DIR"] = str(tmp_path)
+    # `fatal` = unclassifiable: run_guarded re-raises it unretried, and as a
+    # BaseException it punches through the training pipeline's degradation
+    # fallbacks, killing the run AFTER the detect checkpoint landed. The
+    # resumed run re-invokes with the SAME table name — the phase
+    # fingerprint covers the input identity, so a renamed input correctly
+    # invalidates the store.
+    os.environ["DELPHI_FAULT_PLAN"] = "gbdt.*:1:fatal"
+    with pytest.raises(rz.FaultInjected):
+        _tiny_repair("rz_ckpt", df, session)
+    assert (tmp_path / "phase_detect.pkl").is_file(), \
+        "the detect phase must have checkpointed before the crash"
+
+    os.environ.pop("DELPHI_FAULT_PLAN")
+    rz.reset_fault_state()
+    from delphi_tpu import observability as obs
+    rec = obs.start_recording("test.resume")
+    try:
+        resumed = _tiny_repair("rz_ckpt", df, session)
+    finally:
+        obs.stop_recording(rec)
+    counters = rec.registry.snapshot()["counters"]
+    assert counters.get("resilience.checkpoint.hits", 0) >= 1, \
+        "the resumed run must load the detect checkpoint, not recompute it"
+    pd.testing.assert_frame_equal(
+        baseline.reset_index(drop=True), resumed.reset_index(drop=True))
+
+
+def test_checkpointed_rerun_skips_training(tmp_path, session):
+    """Second full run against the same checkpoint dir resumes BOTH phases
+    and still produces the identical frame."""
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(32)],
+        "c0": ["a" if i % 2 else "b" for i in range(32)],
+        "c1": [str(i % 3) for i in range(32)],
+    })
+    df.loc[df.index % 7 == 0, "c1"] = None
+
+    os.environ["DELPHI_CHECKPOINT_DIR"] = str(tmp_path)
+    first = _tiny_repair("rz_rerun", df, session)
+    assert (tmp_path / "phase_detect.pkl").is_file()
+    assert (tmp_path / "phase_train.pkl").is_file()
+
+    from delphi_tpu import observability as obs
+    rec = obs.start_recording("test.rerun")
+    try:
+        second = _tiny_repair("rz_rerun", df, session)
+    finally:
+        obs.stop_recording(rec)
+    counters = rec.registry.snapshot()["counters"]
+    assert counters.get("resilience.checkpoint.hits", 0) >= 2
+    pd.testing.assert_frame_equal(
+        first.reset_index(drop=True), second.reset_index(drop=True))
+
+
+def test_provenance_ledger_records_degradation_notes(session):
+    """A degradation that changed a decision path must stamp the provenance
+    ledger as a run note."""
+    import delphi_tpu.observability.provenance as prov
+
+    led = prov.ProvenanceLedger(":memory:")
+    prev = prov._ledger
+    prov._ledger = led
+    try:
+        def thunk():
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        with pytest.raises(rz.ShrinkBatch):
+            rz.run_guarded("domain.bucket", thunk, can_shrink=True,
+                           policy=rz.RetryPolicy(max_retries=0),
+                           sleep=lambda s: None)
+    finally:
+        prov._ledger = prev
+    notes = led.notes()
+    assert any(n["note"] == "resilience.shrink"
+               and "domain.bucket" in n["detail"] for n in notes), notes

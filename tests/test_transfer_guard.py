@@ -1,18 +1,39 @@
-"""Static guard for the upload seam: every host->device upload in the ops
-layer must route through ops/xfer.py (to_device / device_codes) so the
-transfer ledger sees it. A raw jnp.asarray / jax.device_put added anywhere
-else in delphi_tpu/ops/ is invisible to the ledger and silently breaks the
-bench's transfer accounting — this test fails the build instead."""
+"""Static guards for the device seams.
+
+Upload seam: every host->device upload in the ops layer must route through
+ops/xfer.py (to_device / device_codes) so the transfer ledger sees it. A raw
+jnp.asarray / jax.device_put added anywhere else in delphi_tpu/ops/ is
+invisible to the ledger and silently breaks the bench's transfer
+accounting — this test fails the build instead.
+
+Launch seam: every cached-jitted-kernel invocation in the ops layer must run
+under parallel/resilience.run_guarded, or the resilience plane (classified
+retry, degradation ladder, fault injection) silently loses coverage of that
+launch — a new kernel call site must either sit within a few lines of a
+run_guarded wrapper or be added to the per-line allowlist with a reason."""
 
 import re
 from pathlib import Path
 
 OPS_DIR = Path(__file__).resolve().parent.parent / "delphi_tpu" / "ops"
+MODELS_DIR = Path(__file__).resolve().parent.parent / "delphi_tpu" / "models"
 
 # the ONE allowlisted upload seam
 ALLOWED = {"xfer.py"}
 
 _UPLOAD = re.compile(r"\bjnp\.asarray\(|\bdevice_put\(")
+
+# invocation of a module-level cached jitted kernel handle (the ops idiom:
+# `_foo_kernel = _jit_foo_kernel()` then `_foo_kernel(...)`); the `_jit_*`
+# builders themselves only CONSTRUCT kernels and are excluded, as is
+# pallas_kernels.py (kernel definitions — their launches happen through the
+# wrappers freq.py/entropy.py guard at the call site)
+_KERNEL_CALL = re.compile(r"\b_(?!jit_)\w*kernel\w*\s*\(|\bjnp\.nanpercentile\(")
+_LAUNCH_EXEMPT = {"xfer.py", "pallas_kernels.py"}
+# how close (in lines, either direction) a run_guarded reference must be to
+# a kernel invocation — covers both `run_guarded(..., lambda: _kernel(...))`
+# and thunk-closure-defined-above layouts
+_GUARD_WINDOW = 6
 
 
 def test_ops_layer_has_no_raw_uploads_outside_seam():
@@ -35,3 +56,33 @@ def test_seam_allowlist_is_minimal():
     # a stale entry would quietly disable the guard
     for name in ALLOWED:
         assert (OPS_DIR / name).is_file()
+
+
+def test_ops_layer_kernel_launches_run_guarded():
+    offenders = []
+    for path in sorted(OPS_DIR.glob("*.py")):
+        if path.name in _LAUNCH_EXEMPT:
+            continue
+        lines = path.read_text().splitlines()
+        guarded = [i for i, line in enumerate(lines) if "run_guarded" in line]
+        for i, line in enumerate(lines):
+            stripped = line.strip()
+            if stripped.startswith("#") or not _KERNEL_CALL.search(line):
+                continue
+            if not any(abs(i - g) <= _GUARD_WINDOW for g in guarded):
+                offenders.append(f"{path.name}:{i + 1}: {stripped}")
+    assert not offenders, (
+        "device kernel launch outside the resilience seam (wrap it in "
+        "parallel/resilience.run_guarded so classified retry, the "
+        "degradation ladder and fault injection cover it):\n"
+        + "\n".join(offenders))
+
+
+def test_launch_modules_reference_the_resilience_seam():
+    # wholesale removal guard: the modules that own the pipeline's device
+    # launches must keep routing them through run_guarded
+    for path in (OPS_DIR / "xfer.py", OPS_DIR / "domain.py",
+                 OPS_DIR / "detect.py", OPS_DIR / "freq.py",
+                 MODELS_DIR / "gbdt.py"):
+        assert "run_guarded" in path.read_text(), (
+            f"{path} no longer references the resilience launch seam")
