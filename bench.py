@@ -532,6 +532,170 @@ def chaos() -> int:
     return chaos_smoke(_smoke_frame())
 
 
+# The scoped service-mode plan: one transient upload fault (exercises the
+# retry path) and then a `fatal` at the guarded domain seam — an
+# unclassifiable BaseException the ladder cannot absorb, so the faulted
+# request MUST fail with a structured error while its neighbors survive.
+SERVE_CHAOS_PLAN = "xfer.upload:1:transient,domain.bucket:1:fatal"
+
+
+def serve_chaos_smoke(df=None) -> int:
+    """Service-mode chaos A/B over a live RepairServer:
+
+    1. a solo clean /repair request establishes the reference frame;
+    2. two CONCURRENT requests — one clean, one carrying a per-request
+       ``fault_plan`` (SERVE_CHAOS_PLAN) — must split cleanly: the faulted
+       one returns a structured error (status + fault kind), the clean
+       one's frame is bit-identical to the solo run;
+    3. after ``jax.clear_caches()`` a fourth request must be served warm:
+       ``compile_cache.hits > 0`` (persistent compile cache survived) and
+       ``serve.table_cache.hits > 0`` (encoded-table cache survived).
+
+    Prints one JSON line; exit code 1 on failure."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from delphi_tpu.observability.serve import RepairServer
+
+    if df is None:
+        df = _smoke_frame()
+
+    # force the guarded device domain route for the tiny frame, keep
+    # injected backoffs sub-millisecond, and persist even sub-second CPU
+    # compiles so the warm-rerun assertion has something to hit
+    os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_chaos_")
+    prev_cc = os.environ.get("DELPHI_COMPILE_CACHE_DIR")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(cache_dir,
+                                                          "compile")
+
+    def _as_table(frame):
+        split = json.loads(frame.to_json(orient="split"))
+        return {c: [row[i] for row in split["data"]]
+                for i, c in enumerate(split["columns"])}
+
+    table = _as_table(df)
+    # the faulted session repairs a DIFFERENT table: a distinct content
+    # fingerprint runs the full cold path (the clean table's phase
+    # checkpoints would otherwise skip the guarded seams and the plan
+    # could never fire), and isolation-across-tables is the realistic
+    # multi-tenant shape anyway
+    df_fault = df.copy()
+    df_fault["c2"] = [str((i * 3) % 7) for i in range(len(df_fault))]
+    fault_table = _as_table(df_fault)
+    base = {"table": table, "row_id": "tid", "deadline_s": 600}
+
+    # drop any jit executables compiled earlier in this process: the serve
+    # session must compile (and persist) its own, or the warm-rerun
+    # compile_cache.hits assertion would have nothing on disk to hit
+    jax.clear_caches()
+    srv = RepairServer(port=0, workers=2, cache_dir=cache_dir).start()
+    ok = False
+    info = {}
+    try:
+        def post(body, timeout=600):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/repair",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        _heartbeat("serve chaos solo run")
+        st_solo, solo = post(dict(base, request_id="solo"))
+
+        results = {}
+
+        def _post_to(tag, body):
+            results[tag] = post(body)
+
+        _heartbeat("serve chaos concurrent A/B")
+        threads = [
+            threading.Thread(target=_post_to,
+                             args=("clean", dict(base, request_id="clean"))),
+            threading.Thread(target=_post_to,
+                             args=("fault", dict(base, table=fault_table,
+                                                 request_id="fault",
+                                                 fault_plan=SERVE_CHAOS_PLAN))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        st_clean, clean = results.get("clean", (0, {}))
+        st_fault, fault = results.get("fault", (0, {}))
+
+        _heartbeat("serve chaos warm rerun")
+        jax.clear_caches()
+        st_warm, warm = post(dict(base, request_id="warm"))
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+
+        def metric(name):
+            for line in metrics.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[1])
+            return 0.0
+
+        compile_hits = metric("delphi_compile_cache_hits")
+        table_hits = metric("delphi_serve_table_cache_hits")
+        frames_equal = (st_solo == 200 and st_clean == 200
+                        and solo.get("frame") == clean.get("frame"))
+        warm_equal = (st_warm == 200
+                      and warm.get("frame") == solo.get("frame"))
+        fault_structured = (st_fault == 500
+                            and fault.get("status") == "error"
+                            and bool(fault.get("kind")))
+        ok = (frames_equal and warm_equal and fault_structured
+              and compile_hits > 0 and table_hits > 0)
+        info = {
+            "frames_equal": frames_equal, "warm_equal": warm_equal,
+            "fault_status": st_fault, "fault_kind": fault.get("kind"),
+            "compile_cache_hits": compile_hits,
+            "table_cache_hits": table_hits,
+        }
+    finally:
+        srv.drain(grace_s=10)
+        os.environ.pop("DELPHI_DOMAIN_DEVICE", None)
+        os.environ.pop("DELPHI_RETRY_BASE_S", None)
+        os.environ.pop("DELPHI_COMPILE_CACHE_MIN_S", None)
+        if prev_cc is None:
+            os.environ.pop("DELPHI_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["DELPHI_COMPILE_CACHE_DIR"] = prev_cc
+
+    print(json.dumps({
+        "metric": "serve_chaos_smoke", "value": 1 if ok else 0,
+        "unit": "pass", "vs_baseline": None, "ok": ok,
+        "plan": SERVE_CHAOS_PLAN, **info,
+    }), flush=True)
+    if not ok:
+        print("serve chaos smoke FAILED: concurrent sessions must isolate "
+              f"a scoped fault plan ({info})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def serve_chaos() -> int:
+    """Standalone `bench.py --serve-chaos` entry: CPU backend, live
+    RepairServer, scoped-fault concurrency A/B (see serve_chaos_smoke)."""
+    _force_cpu_backend()
+    return serve_chaos_smoke(_smoke_frame())
+
+
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
 # On-chip measurements persist here keyed by workload@scale: the axon tunnel
@@ -753,6 +917,14 @@ def main() -> None:
                              "deterministic DELPHI_FAULT_PLAN, asserting "
                              "bit-identical frames and matching "
                              "resilience.* counters; exits 1 on failure")
+    parser.add_argument("--serve-chaos", dest="serve_chaos",
+                        action="store_true",
+                        help="service-mode chaos A/B on the CPU backend: "
+                             "concurrent /repair requests against a live "
+                             "RepairServer, a fault plan scoped to ONE of "
+                             "them, asserting the clean request stays "
+                             "bit-identical to a solo run and warm caches "
+                             "survive; exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -762,6 +934,9 @@ def main() -> None:
 
     if args.chaos:
         sys.exit(chaos())
+
+    if args.serve_chaos:
+        sys.exit(serve_chaos())
 
     if args._child:
         _child_main(args)

@@ -11,6 +11,11 @@ Observability: `--metrics-out`/`--metrics-port` cover the run report and
 live telemetry; `--provenance-out` records the per-cell repair provenance
 ledger; `--baseline-report` runs the cross-run drift gate against a prior
 run report (exit code 3 when `--drift-fail-over` trips).
+
+Service mode: `--serve [--serve-port P] [--serve-cache-dir D]` skips the
+batch arguments entirely and runs the persistent repair service
+(`delphi_tpu/observability/serve.py`): POST /repair, GET /metrics //healthz
+//report, graceful drain on SIGTERM. See docs/source/robustness.rst.
 """
 
 import argparse
@@ -27,11 +32,30 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="delphi_tpu batch repair")
     parser.add_argument("--db", dest="db", type=str, default="",
                         help="database name of the input table")
-    parser.add_argument("--input", dest="input", type=str, required=True,
-                        help="CSV path or registered table name")
-    parser.add_argument("--row-id", dest="row_id", type=str, required=True)
-    parser.add_argument("--output", dest="output", type=str, required=True,
-                        help="output CSV path")
+    parser.add_argument("--input", dest="input", type=str, default=None,
+                        help="CSV path or registered table name "
+                             "(required unless --serve)")
+    parser.add_argument("--row-id", dest="row_id", type=str, default=None,
+                        help="row-id column (required unless --serve)")
+    parser.add_argument("--output", dest="output", type=str, default=None,
+                        help="output CSV path (required unless --serve)")
+    parser.add_argument("--serve", dest="serve", action="store_true",
+                        help="run the persistent repair service instead of "
+                             "a batch repair: POST /repair with a JSON "
+                             "table, concurrent sessions share the warm "
+                             "compile/table/model caches, SIGTERM drains "
+                             "gracefully (docs/source/robustness.rst)")
+    parser.add_argument("--serve-port", dest="serve_port", type=int,
+                        default=8080,
+                        help="service port for --serve (0 = ephemeral)")
+    parser.add_argument("--serve-cache-dir", dest="serve_cache_dir",
+                        type=str, default="",
+                        help="warm-state directory for --serve (compile "
+                             "cache, per-fingerprint model checkpoints, "
+                             "phase checkpoints); a stable path makes "
+                             "restarts warm. Equivalent to "
+                             "DELPHI_SERVE_CACHE_DIR / "
+                             "repair.serve.cache_dir")
     parser.add_argument("--targets", dest="targets", type=str, default="",
                         help="comma-separated target attributes")
     parser.add_argument("--constraints", dest="constraints", type=str, default="",
@@ -125,6 +149,15 @@ def main(argv=None) -> int:
     maybe_initialize_distributed()
 
     session = get_session()
+    if args.serve:
+        if args.fault_plan:
+            session.conf["repair.fault.plan"] = args.fault_plan
+        from delphi_tpu.observability.serve import serve
+        return serve(port=args.serve_port,
+                     cache_dir=args.serve_cache_dir or None)
+    if not (args.input and args.row_id and args.output):
+        parser.error("--input, --row-id and --output are required "
+                     "(unless --serve)")
     recorder = None
     if args.metrics_port is not None:
         session.conf["repair.metrics.port"] = str(args.metrics_port)

@@ -2437,9 +2437,13 @@ class RepairModel:
         from delphi_tpu import observability as obs
 
         # a fresh run starts with clean resilience latches: an abort armed by
-        # a previous run's watchdog (or its CPU fallback) must not leak in
-        _resilience.clear_abort()
-        _resilience.clear_cpu_fallback()
+        # a previous run's watchdog (or its CPU fallback) must not leak in.
+        # Inside a serving-plane RequestScope the latches are per-request
+        # already, and clearing the process globals would erase another
+        # in-flight session's state.
+        if _resilience.current_scope() is None:
+            _resilience.clear_abort()
+            _resilience.clear_cpu_fallback()
 
         report_path = obs.metrics_path()
         recorder = None

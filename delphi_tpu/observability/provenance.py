@@ -29,6 +29,7 @@ that embed in the run report as schema v3 and merge across hosts through
 across runs.
 """
 
+import contextlib
 import json
 import os
 import tempfile
@@ -509,9 +510,43 @@ def scorecard_summary(scorecards: Optional[Dict[str, Dict[str, Any]]]) \
 # spans._current / the metrics registry).
 _ledger: Optional[ProvenanceLedger] = None
 
+# Per-thread ledgers for the serving plane: each /repair request gets its
+# own ledger so concurrent sessions' cells never interleave in one file.
+# _scoped_count gates the thread-local lookup so the disabled path stays a
+# global read + one int compare.
+_scoped_tls = threading.local()
+_scoped_count = 0
+_scoped_lock = threading.Lock()
+
 
 def active_ledger() -> Optional[ProvenanceLedger]:
+    if _scoped_count:
+        led = getattr(_scoped_tls, "ledger", None)
+        if led is not None:
+            return led
     return _ledger
+
+
+@contextlib.contextmanager
+def scoped_ledger(ledger: Optional[ProvenanceLedger]):
+    """Routes this thread's provenance writes into ``ledger`` (a no-op
+    context when None). The serving plane wraps each request's run in one
+    of these; the process-global ledger, if any, is shadowed for the
+    duration so per-request cells land in per-request files."""
+    global _scoped_count
+    if ledger is None:
+        yield None
+        return
+    prev = getattr(_scoped_tls, "ledger", None)
+    _scoped_tls.ledger = ledger
+    with _scoped_lock:
+        _scoped_count += 1
+    try:
+        yield ledger
+    finally:
+        _scoped_tls.ledger = prev
+        with _scoped_lock:
+            _scoped_count -= 1
 
 
 def maybe_start(recorder: Any) -> None:

@@ -1,0 +1,747 @@
+"""Serving plane: a persistent repair service over the live HTTP plane.
+
+``RepairServer`` turns the one-shot pipeline into a long-lived process that
+multiplexes concurrent repair sessions over shared warm state:
+
+* the **persistent compile cache** (``parallel/compile_plane.py``) — armed
+  once at server start, so every request after the first reuses compiled
+  executables (``compile_cache.hits``);
+* **device-resident column codes** (``ops/xfer.py``) — input tables are
+  encoded once per content fingerprint, registered in the session catalog,
+  and their uploaded code buffers survive on the column objects across
+  requests;
+* **trained models cached by table fingerprint** — each request points
+  ``model.checkpoint_path`` at a per-fingerprint directory under the serve
+  cache dir, so a repeated table skips training (``train.checkpoint_hits``)
+  and a restarted server rebuilds its warm state from disk.
+
+Robustness-first control plane:
+
+* **admission/queueing**: a bounded queue (``DELPHI_SERVE_QUEUE_DEPTH``)
+  with load-shedding — 429 + ``Retry-After`` when the queue is full, the
+  process RSS exceeds ``DELPHI_SERVE_MAX_RSS_GB``, or the span heartbeat
+  says the in-flight work is wedged; 503 + ``Retry-After`` while draining;
+* **per-request deadlines** (``DELPHI_SERVE_DEADLINE_S`` or the request's
+  ``deadline_s`` field) threaded into the resilience seam as a
+  :class:`~delphi_tpu.parallel.resilience.RequestScope`: retry backoff is
+  clipped to the remaining budget and expiry raises ``DeadlineExceeded`` at
+  the next guarded seam / phase boundary → HTTP 504, never a wedged worker;
+* **fault isolation**: each request runs under its own ``RequestScope``
+  (private fault plan, abort latch, CPU latch, checkpoint dir) and its own
+  provenance ledger, so one request's OOM or injected fault walks the
+  degradation ladder, fails only that request, and evicts only the state it
+  dirtied (its table-cache entry, device buffers, and model checkpoint) —
+  other in-flight sessions stay bit-identical;
+* **graceful drain**: :meth:`RepairServer.begin_drain` (or SIGTERM via
+  :func:`install_signal_handlers`) stops admission; :meth:`~RepairServer.
+  drain` waits a grace period, then arms each remaining request's scoped
+  abort so it stops at the next phase boundary with its phase checkpoints
+  on disk (resumable on resubmit), flushes per-request provenance ledgers,
+  and tears the plane down.
+
+The HTTP surface extends the PR 2 live plane: ``GET /metrics`` (Prometheus,
+including all ``resilience.*`` and ``serve.*`` series), ``GET /healthz``
+(admission state + queue depth), ``GET /report`` (in-flight run report),
+``POST /repair`` (a micro-batched repair request), ``POST /drain``.
+
+A ``/repair`` request body::
+
+    {"table": {"tid": ["0", ...], "c0": [...], ...},   # column -> values
+     "row_id": "tid",
+     "deadline_s": 30.0,                                # optional
+     "options": {"model.max_training_row_num": "64"},   # optional
+     "fault_plan": "domain.bucket:1:oom",               # optional (chaos)
+     "request_id": "r1"}                                # optional
+
+and the 200 response is ``{"request_id", "status": "ok", "rows",
+"frame": [...records...]}`` — ``frame`` rows are sorted by all columns so
+two servers repairing the same table respond byte-identically.
+"""
+
+import hashlib
+import json
+import os
+import queue
+import signal
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from delphi_tpu.observability.registry import (
+    counter_inc, gauge_set, histogram_observe,
+)
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_DEF_WORKERS = 2
+_DEF_QUEUE_DEPTH = 8
+_DEF_DEADLINE_S = 300.0
+_DEF_RETRY_AFTER_S = 1.0
+_DEF_DRAIN_GRACE_S = 30.0
+_DEF_STALL_SHED_S = 120.0
+
+#: Counters pre-seeded to zero at server start so the Prometheus endpoint
+#: always exposes the full admission/resilience series (a scrape before the
+#: first fault must see `delphi_resilience_retries 0`, not a missing metric).
+_SEED_COUNTERS = (
+    "serve.requests", "serve.accepted", "serve.completed", "serve.failed",
+    "serve.shed", "serve.rejected_draining", "serve.deadline_expired",
+    "serve.aborted", "serve.handler_timeouts",
+    "serve.table_cache.hits", "serve.table_cache.misses",
+    "resilience.retries", "resilience.injected",
+    "resilience.aborts_requested", "resilience.deadline_expired",
+    "resilience.deadline_clipped", "resilience.plan.unmatched",
+    "resilience.degrade.shrink", "resilience.degrade.evict",
+    "resilience.degrade.cpu_fallback",
+    "resilience.checkpoint.hits", "resilience.checkpoint.misses",
+    "resilience.checkpoint.stale", "resilience.checkpoint.corrupt",
+    "resilience.checkpoint.saves",
+)
+
+
+def _knob_float(env: str, conf: str, default: float) -> float:
+    from delphi_tpu.parallel.resilience import _env_or_conf
+    return _env_or_conf(env, conf, float, default)
+
+
+def _knob_int(env: str, conf: str, default: int) -> int:
+    from delphi_tpu.parallel.resilience import _env_or_conf
+    return _env_or_conf(env, conf, int, default)
+
+
+class Rejection(Exception):
+    """An admission refusal carrying its HTTP mapping."""
+
+    def __init__(self, status: int, reason: str,
+                 retry_after_s: Optional[float] = None) -> None:
+        self.status = int(status)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        super().__init__(reason)
+
+
+class RepairJob:
+    """One admitted /repair request moving through the queue → worker →
+    response pipeline. ``done`` is the handler's rendezvous; ``scope`` is
+    set by the worker so drain/abandonment can arm a scoped abort."""
+
+    def __init__(self, request_id: str, payload: Dict[str, Any],
+                 deadline_at: Optional[float]) -> None:
+        self.request_id = request_id
+        self.payload = payload
+        self.deadline_at = deadline_at  # time.monotonic() basis
+        self.enqueued_at = time.perf_counter()
+        self.fp: Optional[str] = None  # table fingerprint once resolved
+        self.scope: Optional[Any] = None
+        self.status_code: int = 500
+        self.response: Dict[str, Any] = {"request_id": request_id,
+                                         "status": "error",
+                                         "error": "not executed"}
+        self.abandoned = False
+        self.done = threading.Event()
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
+
+class RepairServer:
+    """The persistent repair service. Lifecycle: ``start()`` →
+    (requests...) → ``drain()`` (or ``stop()`` for an immediate teardown).
+    ``port`` is the bound HTTP port (pass 0 for ephemeral — tests)."""
+
+    def __init__(self, port: int = 0, workers: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 cache_dir: Optional[str] = None) -> None:
+        self.requested_port = int(port)
+        self.workers = workers if workers is not None else _knob_int(
+            "DELPHI_SERVE_WORKERS", "repair.serve.workers", _DEF_WORKERS)
+        self.workers = max(1, int(self.workers))
+        depth = queue_depth if queue_depth is not None else _knob_int(
+            "DELPHI_SERVE_QUEUE_DEPTH", "repair.serve.queue_depth",
+            _DEF_QUEUE_DEPTH)
+        self.queue_depth = max(1, int(depth))
+        cache = cache_dir or os.environ.get("DELPHI_SERVE_CACHE_DIR")
+        if not cache:
+            from delphi_tpu.session import get_session
+            cache = get_session().conf.get("repair.serve.cache_dir")
+        # a stable cache dir is what makes restart warm (model checkpoints,
+        # phase checkpoints, compile cache all live under it); the tempdir
+        # default still gives warmth within one server lifetime
+        self.cache_dir = str(cache) if cache else tempfile.mkdtemp(
+            prefix="delphi_serve_")
+        self.default_deadline_s = _knob_float(
+            "DELPHI_SERVE_DEADLINE_S", "repair.serve.deadline_s",
+            _DEF_DEADLINE_S)
+        self.retry_after_s = _knob_float(
+            "DELPHI_SERVE_RETRY_AFTER_S", "repair.serve.retry_after_s",
+            _DEF_RETRY_AFTER_S)
+        self.drain_grace_s = _knob_float(
+            "DELPHI_SERVE_DRAIN_GRACE_S", "repair.serve.drain_grace_s",
+            _DEF_DRAIN_GRACE_S)
+        self.max_rss_gb = _knob_float(
+            "DELPHI_SERVE_MAX_RSS_GB", "repair.serve.max_rss_gb", 0.0)
+        self.stall_shed_s = _knob_float(
+            "DELPHI_SERVE_STALL_SHED_S", "repair.serve.stall_shed_s",
+            _DEF_STALL_SHED_S)
+
+        self.recorder: Optional[Any] = None
+        self._own_recorder: Optional[Any] = None
+        self._queue: "queue.Queue[Optional[RepairJob]]" = queue.Queue(
+            maxsize=self.queue_depth)
+        self._workers: List[threading.Thread] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._draining = False
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._active: Dict[str, RepairJob] = {}
+        # table fingerprint -> (catalog name, EncodedTable)
+        self._tables: Dict[str, Tuple[str, Any]] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def _models_dir(self, fp: str) -> str:
+        return os.path.join(self.cache_dir, "models", fp[:16])
+
+    def _ckpt_dir(self, fp: str) -> str:
+        return os.path.join(self.cache_dir, "ckpt", fp[:16])
+
+    def start(self) -> "RepairServer":
+        from delphi_tpu import observability as obs
+
+        os.makedirs(self.cache_dir, exist_ok=True)
+        # arm the persistent compile cache under the serve cache dir unless
+        # one is already configured — warm compiles across requests AND
+        # across restarts come from here
+        if not os.environ.get("DELPHI_COMPILE_CACHE_DIR") \
+                and not os.environ.get("DELPHI_XLA_CACHE_DIR"):
+            os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(
+                self.cache_dir, "compile")
+        # one long-lived recorder for the server's whole life: per-request
+        # model.run() recorders nest into it (start_recording returns None
+        # when one is active), so every request's metrics land in ONE
+        # registry served by /metrics
+        self._own_recorder = obs.start_recording("repair.serve")
+        self.recorder = self._own_recorder or obs.current_recorder()
+        if self.recorder is None:  # pragma: no cover - defensive
+            raise RuntimeError("serving plane requires a run recorder")
+        for name in _SEED_COUNTERS:
+            counter_inc(name, 0)
+        gauge_set("serve.queue_depth", 0)
+        gauge_set("serve.in_flight", 0)
+        gauge_set("serve.draining", 0)
+        self._rebuild_warm_state()
+
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"delphi-serve-worker-{i}")
+            t.start()
+            self._workers.append(t)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.requested_port),
+                                          _ServeHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.repair_server = self  # type: ignore[attr-defined]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="delphi-serve-http")
+        self._http_thread.start()
+        _logger.info(
+            f"repair service listening on 127.0.0.1:{self.port} "
+            f"(workers={self.workers}, queue={self.queue_depth}, "
+            f"cache={self.cache_dir})")
+        return self
+
+    def _rebuild_warm_state(self) -> None:
+        """Crash-safe warm-state inventory on (re)start: count the model
+        checkpoints and phase checkpoints a previous life left under the
+        cache dir. They are loaded lazily — the fingerprinted stores
+        validate on first use — so a restart is warm without trusting any
+        in-memory state that died with the old process."""
+        def _count(sub: str) -> int:
+            d = os.path.join(self.cache_dir, sub)
+            try:
+                return len([e for e in os.listdir(d)
+                            if os.path.isdir(os.path.join(d, e))
+                            or e.endswith(".pkl")])
+            except OSError:
+                return 0
+        models = _count("models")
+        ckpts = _count("ckpt")
+        gauge_set("serve.warm_models", models)
+        gauge_set("serve.warm_checkpoints", ckpts)
+        if models or ckpts:
+            _logger.info(f"warm-state rebuild: {models} model checkpoint "
+                         f"dir(s), {ckpts} phase-checkpoint dir(s) under "
+                         f"{self.cache_dir}")
+
+    def begin_drain(self) -> None:
+        """Stops admission; in-flight and queued work keeps running."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        gauge_set("serve.draining", 1)
+        _logger.info("repair service draining: admission closed")
+
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Graceful shutdown: close admission, give in-flight requests
+        ``grace_s`` to finish, then arm each straggler's scoped abort so it
+        stops at the next guarded seam / phase boundary — its phase
+        checkpoints (written at every completed phase) stay on disk, so a
+        resubmitted identical request resumes instead of recomputing.
+        Finally tears down workers, HTTP, and the recorder."""
+        self.begin_drain()
+        grace = self.drain_grace_s if grace_s is None else float(grace_s)
+        deadline = time.monotonic() + max(0.0, grace)
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = self._in_flight == 0 and self._queue.empty()
+            if idle:
+                break
+            time.sleep(0.05)
+        with self._lock:
+            stragglers = list(self._active.values())
+        for job in stragglers:
+            if job.scope is not None:
+                job.scope.request_abort("server draining")
+        if stragglers:
+            _logger.warning(
+                f"drain grace expired: aborting {len(stragglers)} in-flight "
+                "request(s) at their next checkpoint boundary")
+            # give the aborts a moment to land at a seam
+            settle = time.monotonic() + 10.0
+            while time.monotonic() < settle:
+                with self._lock:
+                    if self._in_flight == 0:
+                        break
+                time.sleep(0.05)
+        self.stop()
+
+    def stop(self) -> None:
+        """Immediate teardown (drain() calls this last)."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        for _ in self._workers:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:  # drop a queued job slot to fit the sentinel
+                try:
+                    dropped = self._queue.get_nowait()
+                    if dropped is not None:
+                        dropped.status_code = 503
+                        dropped.response = {
+                            "request_id": dropped.request_id,
+                            "status": "rejected",
+                            "error": "server shutting down"}
+                        dropped.done.set()
+                except queue.Empty:
+                    pass
+                self._queue.put_nowait(None)
+        for t in self._workers:
+            t.join(timeout=10.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=10.0)
+            self._httpd = None
+        if self._own_recorder is not None:
+            from delphi_tpu import observability as obs
+            obs.stop_recording(self._own_recorder)
+            self._own_recorder = None
+        _logger.info("repair service stopped")
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Blocks until the server is stopped (main.py --serve)."""
+        return self._stopped.wait(timeout)
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, payload: Dict[str, Any]) -> RepairJob:
+        """Admission control: draining → 503, overload (RSS / wedged
+        heartbeat / full queue) → 429 with Retry-After. Returns the queued
+        job; the caller waits on ``job.done``."""
+        counter_inc("serve.requests")
+        with self._lock:
+            draining = self._draining
+        if draining or self._stopped.is_set():
+            counter_inc("serve.rejected_draining")
+            raise Rejection(503, "server is draining",
+                            retry_after_s=self.retry_after_s)
+        if self.max_rss_gb > 0:
+            from delphi_tpu.observability.live import _rss_gb
+            rss = _rss_gb()
+            if rss is not None and rss > self.max_rss_gb:
+                counter_inc("serve.shed")
+                raise Rejection(
+                    429, f"process RSS {rss:.2f} GiB over the "
+                         f"{self.max_rss_gb:.2f} GiB admission limit",
+                    retry_after_s=self.retry_after_s)
+        if self.stall_shed_s > 0 and self.recorder is not None:
+            with self._lock:
+                busy = self._in_flight > 0
+            idle = time.perf_counter() - self.recorder.last_transition
+            if busy and idle > self.stall_shed_s:
+                counter_inc("serve.shed")
+                raise Rejection(
+                    429, f"in-flight work wedged ({idle:.0f}s without a "
+                         "span heartbeat)",
+                    retry_after_s=self.retry_after_s)
+        request_id = str(payload.get("request_id")
+                         or f"req-{time.monotonic_ns():x}")
+        deadline_s = payload.get("deadline_s", self.default_deadline_s)
+        try:
+            deadline_s = float(deadline_s)
+        except (TypeError, ValueError):
+            raise Rejection(400, f"bad deadline_s: {deadline_s!r}")
+        deadline_at = (time.monotonic() + deadline_s
+                       if deadline_s > 0 else None)
+        job = RepairJob(request_id, payload, deadline_at)
+        try:
+            self._queue.put_nowait(job)
+        except queue.Full:
+            counter_inc("serve.shed")
+            raise Rejection(429, "admission queue full",
+                            retry_after_s=self.retry_after_s)
+        counter_inc("serve.accepted")
+        gauge_set("serve.queue_depth", self._queue.qsize())
+        return job
+
+    # -- execution -----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            gauge_set("serve.queue_depth", self._queue.qsize())
+            histogram_observe("serve.queue_wait_seconds",
+                              time.perf_counter() - job.enqueued_at)
+            with self._lock:
+                self._in_flight += 1
+                self._active[job.request_id] = job
+            gauge_set("serve.in_flight", self._in_flight)
+            try:
+                self._execute(job)
+            except BaseException as e:  # a worker must survive anything
+                _logger.warning(
+                    f"request {job.request_id}: unhandled "
+                    f"{type(e).__name__}: {e}")
+                job.status_code = 500
+                job.response = {"request_id": job.request_id,
+                                "status": "error",
+                                "error": f"{type(e).__name__}: {e}"}
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+                    self._active.pop(job.request_id, None)
+                gauge_set("serve.in_flight", self._in_flight)
+                job.done.set()
+
+    def _resolve_table(self, payload: Dict[str, Any]) -> Tuple[str, str]:
+        """Warm table cache: encode + validate once per content
+        fingerprint, register the EncodedTable in the session catalog
+        (device-resident code buffers then persist on its column objects
+        across requests)."""
+        import pandas as pd
+
+        from delphi_tpu.session import get_session
+        from delphi_tpu.table import check_input_table
+
+        table = payload["table"]
+        row_id = payload["row_id"]
+        blob = json.dumps({"row_id": row_id, "table": table},
+                          sort_keys=True, default=str)
+        fp = hashlib.sha1(blob.encode()).hexdigest()
+        with self._lock:
+            cached = self._tables.get(fp)
+        if cached is not None:
+            counter_inc("serve.table_cache.hits")
+            return cached[0], fp
+        name = f"serve_{fp[:16]}"
+        df = pd.DataFrame({c: pd.Series(v) for c, v in table.items()})
+        encoded, _cont = check_input_table(df, row_id, name)
+        get_session().register(name, encoded)
+        with self._lock:
+            self._tables[fp] = (name, encoded)
+            n = len(self._tables)
+        counter_inc("serve.table_cache.misses")
+        gauge_set("serve.warm_tables", n)
+        return name, fp
+
+    def _evict_dirty(self, fp: Optional[str],
+                     request_id: Optional[str] = None) -> None:
+        """Drops ONLY the state a failed request dirtied: its device-
+        resident code buffers (a device fault may have corrupted them;
+        evicting is always safe — the next use re-uploads ground truth
+        bit-identically), its fingerprint cache entry (so the next request
+        re-validates and re-registers), and its per-fingerprint model
+        checkpoint. Other fingerprints' warm state is untouched. The
+        session-catalog entry — host-side encoded data a device fault
+        cannot dirty — stays, so a concurrent request on the same table
+        that already resolved the name keeps running unharmed."""
+        if fp is None:
+            return
+        import shutil
+
+        from delphi_tpu.ops.xfer import evict_device_codes
+
+        with self._lock:
+            entry = self._tables.pop(fp, None)
+            n = len(self._tables)
+        if entry is not None:
+            _name, encoded = entry
+            try:
+                evict_device_codes(encoded.columns)
+            except Exception:  # pragma: no cover - eviction is best-effort
+                pass
+            gauge_set("serve.warm_tables", n)
+        shutil.rmtree(self._models_dir(fp), ignore_errors=True)
+
+    def _execute(self, job: RepairJob) -> None:
+        from delphi_tpu.api import Delphi
+        from delphi_tpu.errors import NullErrorDetector
+        from delphi_tpu.observability import provenance
+        from delphi_tpu.parallel import resilience
+
+        t0 = time.perf_counter()
+        rid = job.request_id
+        payload = job.payload
+        fp: Optional[str] = None
+        ledger: Optional[Any] = None
+        try:
+            rem = job.remaining_s()
+            if rem is not None and rem <= 0:
+                raise resilience.DeadlineExceeded(
+                    f"request {rid} deadline expired after "
+                    f"{-rem:.3f}s in the admission queue")
+            name, fp = self._resolve_table(payload)
+            job.fp = fp
+            model = Delphi.getOrCreate().repair \
+                .setTableName(name) \
+                .setRowId(payload["row_id"]) \
+                .setErrorDetectors([NullErrorDetector()])
+            model.option("model.checkpoint_path", self._models_dir(fp))
+            for key, value in (payload.get("options") or {}).items():
+                model.option(str(key), str(value))
+            prov_dir = os.environ.get("DELPHI_SERVE_PROVENANCE_DIR")
+            if prov_dir:
+                os.makedirs(prov_dir, exist_ok=True)
+                ledger = provenance.ProvenanceLedger(
+                    os.path.join(prov_dir, f"{rid}.jsonl"))
+            scope = resilience.RequestScope(
+                rid, fault_plan=str(payload.get("fault_plan") or ""),
+                deadline_s=rem, checkpoint_dir=self._ckpt_dir(fp))
+            job.scope = scope
+            with resilience.request_scope(scope), \
+                    provenance.scoped_ledger(ledger):
+                out = model.run()
+            # canonical response ordering: sorted by all columns, so two
+            # servers (or a solo run) repairing the same table respond
+            # byte-identically regardless of internal work order
+            out = out.sort_values(list(out.columns)).reset_index(drop=True)
+            job.status_code = 200
+            job.response = {
+                "request_id": rid, "status": "ok", "rows": int(len(out)),
+                "frame": json.loads(out.to_json(orient="records")),
+            }
+            counter_inc("serve.completed")
+        except resilience.DeadlineExceeded as e:
+            counter_inc("serve.deadline_expired")
+            job.status_code = 504
+            job.response = {"request_id": rid, "status": "deadline_exceeded",
+                            "error": str(e)}
+        except resilience.RunAborted as e:
+            # drain-time abort: phase checkpoints for every completed phase
+            # are already on disk under the request's checkpoint dir
+            counter_inc("serve.aborted")
+            job.status_code = 503
+            job.response = {
+                "request_id": rid, "status": "aborted", "error": str(e),
+                "resumable": fp is not None
+                and os.path.isdir(self._ckpt_dir(fp)),
+            }
+        except KeyError as e:
+            job.status_code = 400
+            job.response = {"request_id": rid, "status": "bad_request",
+                            "error": f"missing field {e}"}
+        except BaseException as e:
+            # one request's failure — injected fault, OOM past the ladder,
+            # bad options, a genuine bug — is THAT request's structured
+            # error; evict only the warm state it dirtied
+            counter_inc("serve.failed")
+            kind = resilience.classify_fault(e)
+            if isinstance(e, resilience.FaultInjected):
+                kind = e.kind
+            job.status_code = 400 if isinstance(e, ValueError) else 500
+            job.response = {"request_id": rid, "status": "error",
+                            "kind": kind or type(e).__name__,
+                            "error": f"{type(e).__name__}: {e}"}
+            self._evict_dirty(fp, request_id=rid)
+        finally:
+            if ledger is not None:
+                try:
+                    ledger.write()
+                except Exception as e:  # pragma: no cover - best effort
+                    _logger.warning(f"request {rid}: provenance flush "
+                                    f"failed: {e}")
+            histogram_observe("serve.request_seconds",
+                              time.perf_counter() - t0)
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _logger.debug("repair service: " + fmt % args)
+
+    @property
+    def _server(self) -> RepairServer:
+        return self.server.repair_server  # type: ignore[attr-defined]
+
+    def _respond(self, status: int, body: Dict[str, Any],
+                 retry_after_s: Optional[float] = None,
+                 content_type: str = "application/json") -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        if retry_after_s is not None:
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after_s)))))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _respond_text(self, status: int, content_type: str,
+                      body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        from delphi_tpu.observability.live import (
+            PROMETHEUS_CONTENT_TYPE, render_prometheus,
+        )
+
+        srv = self._server
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                with srv._lock:
+                    body = {
+                        "status": "draining" if srv._draining else "ok",
+                        "in_flight": srv._in_flight,
+                        "queue_depth": srv._queue.qsize(),
+                        "warm_tables": len(srv._tables),
+                        "workers": srv.workers,
+                    }
+                self._respond(200, body)
+            elif path == "/metrics":
+                text = render_prometheus(srv.recorder).encode()
+                self._respond_text(200, PROMETHEUS_CONTENT_TYPE, text)
+            elif path == "/report":
+                from delphi_tpu.observability.report import build_run_report
+                report = build_run_report(srv.recorder, run={},
+                                          status="serving", error=None)
+                self._respond(200, report)
+            else:
+                self._respond(404, {"error": f"unknown path {path}"})
+        except Exception as e:  # pragma: no cover - defensive
+            try:
+                self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        srv = self._server
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/drain":
+                srv.begin_drain()
+                self._respond(200, {"status": "draining"})
+                return
+            if path != "/repair":
+                self._respond(404, {"error": f"unknown path {path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._respond(400, {"status": "bad_request",
+                                    "error": f"bad JSON body: {e}"})
+                return
+            if not isinstance(payload, dict) \
+                    or not isinstance(payload.get("table"), dict) \
+                    or not isinstance(payload.get("row_id"), str):
+                self._respond(400, {
+                    "status": "bad_request",
+                    "error": "body must be a JSON object with a 'table' "
+                             "object and a 'row_id' string"})
+                return
+            try:
+                job = srv.submit(payload)
+            except Rejection as r:
+                self._respond(r.status, {"status": "rejected",
+                                         "error": r.reason},
+                              retry_after_s=r.retry_after_s)
+                return
+            # rendezvous: the worker's deadline machinery normally answers
+            # well before this backstop; the +grace covers a request wedged
+            # between guarded seams, and abandoning it arms a scoped abort
+            # so the worker is reclaimed at the next seam
+            rem = job.remaining_s()
+            wait_s = None if rem is None else max(rem, 0.0) + 15.0
+            if not job.done.wait(timeout=wait_s):
+                job.abandoned = True
+                if job.scope is not None:
+                    job.scope.request_abort("client deadline abandoned")
+                counter_inc("serve.handler_timeouts")
+                self._respond(504, {
+                    "request_id": job.request_id,
+                    "status": "deadline_exceeded",
+                    "error": "request did not finish within its deadline"})
+                return
+            self._respond(job.status_code, job.response)
+        except Exception as e:  # pragma: no cover - defensive
+            try:
+                self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+def install_signal_handlers(server: RepairServer) -> None:
+    """SIGTERM/SIGINT → graceful drain (main-thread only; ``main.py
+    --serve`` calls this, tests drive ``begin_drain``/``drain``
+    directly)."""
+    def _handler(signum: int, frame: Any) -> None:
+        _logger.info(f"signal {signum}: draining repair service")
+        threading.Thread(target=server.drain, daemon=True,
+                         name="delphi-serve-drain").start()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+
+
+def serve(port: int = 8080, workers: Optional[int] = None,
+          cache_dir: Optional[str] = None) -> int:
+    """Blocking entry point for ``main.py --serve``: starts the service,
+    installs signal handlers, and waits until a drain completes."""
+    server = RepairServer(port=port, workers=workers, cache_dir=cache_dir)
+    server.start()
+    install_signal_handlers(server)
+    print(f"delphi repair service on 127.0.0.1:{server.port} "
+          f"(cache {server.cache_dir})", flush=True)
+    server.wait()
+    return 0

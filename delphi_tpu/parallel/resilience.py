@@ -43,7 +43,33 @@ crash/resume).
 last completed phase; the PR 2 stall watchdog routes through
 :func:`on_watchdog_stall` to request a safe abort (the last completed
 phase's checkpoint is already on disk) instead of only dumping stacks.
+
+**Request scopes** (the serving plane, ``observability/serve.py``): a
+long-lived process multiplexing concurrent repair sessions cannot share the
+process-global latches above — one request's fault plan, CPU latch, or
+abort must never leak into another in-flight session. :class:`RequestScope`
+carries all of that state per request, activated thread-locally via
+:func:`request_scope`:
+
+* a **per-request fault plan** with its own fire-once/entry-count state —
+  while a scope is active the process-global ``DELPHI_FAULT_PLAN`` is NOT
+  consulted, so ``bench.py --serve-chaos`` can inject faults into exactly
+  one of N concurrent sessions;
+* a **per-request deadline**: :func:`maybe_abort` (guarded seam entries and
+  phase boundaries) raises :class:`DeadlineExceeded` once it expires, and
+  ``run_guarded`` clips retry backoff to the remaining budget — a retry
+  schedule can never sleep a worker past its deadline;
+* per-request **abort** and **CPU-fallback** latches (scoped requests skip
+  the process-global latches entirely), and an optional per-request
+  **checkpoint directory** override so concurrent sessions never collide on
+  phase-checkpoint files.
+
+The scope is thread-local: it covers every seam entered on the request's
+worker thread (which is where the pipeline's guarded launches run), not
+helper threads the pipeline may spawn internally.
 """
+
+import contextlib
 
 import fnmatch
 import json
@@ -114,6 +140,17 @@ class RunAborted(BaseException):
     path" by a catch-all in the training pipeline."""
 
 
+class DeadlineExceeded(BaseException):
+    """A request-scope deadline expired: raised at the next guarded seam
+    entry / phase boundary, or eagerly by ``run_guarded`` when the next
+    retry backoff would sleep past the deadline.
+
+    BaseException for the same reason as :class:`RunAborted`: a deadline
+    must terminate the request promptly — letting a catch-all degradation
+    fallback absorb it would keep burning a worker the client has already
+    given up on. The serving plane maps it to HTTP 504."""
+
+
 _INJECT_MESSAGES = {
     KIND_OOM: ("RESOURCE_EXHAUSTED: out of memory while trying to allocate "
                "buffer (injected at {site} call {n})"),
@@ -165,7 +202,7 @@ def classify_fault(exc: BaseException) -> Optional[str]:
     """Maps an exception to a fault kind, or None for unclassifiable
     failures (program bugs, bad input) that must re-raise unretried. The
     resilience plane's own control-flow exceptions are never faults."""
-    if isinstance(exc, (ShrinkBatch, RunAborted)):
+    if isinstance(exc, (ShrinkBatch, RunAborted, DeadlineExceeded)):
         return None
     if isinstance(exc, BackendInitTimeout):
         return KIND_INIT_TIMEOUT
@@ -234,6 +271,28 @@ def default_policy() -> RetryPolicy:
 
 # -- fault injection ---------------------------------------------------------
 
+#: Every site name passed to :func:`run_guarded` (plus the backend-init
+#: probe's injection point). test_transfer_guard.py statically asserts the
+#: source stays in sync, so a new guarded seam that forgets to register
+#: here fails tier-1 rather than silently escaping plan validation.
+KNOWN_SITES = frozenset({
+    "backend.init",
+    "xfer.upload",
+    "freq.singles",
+    "freq.pairs",
+    "freq.pairs_pallas",
+    "freq.distinct",
+    "domain.score",
+    "domain.weak_label",
+    "domain.bucket",
+    "detect.percentile",
+    "detect.rank",
+    "detect.sorted_count",
+    "detect.group_extrema",
+    "gbdt.cv_chunk",
+    "gbdt.fit_chunk",
+})
+
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")
 
 
@@ -274,22 +333,60 @@ def _fault_plan_text() -> str:
 _plan_lock = threading.Lock()
 _plan_state: Dict[str, Any] = {"text": None, "triples": (), "fired": set(),
                                "calls": {}}
+_validated_plans: set = set()
+
+
+def validate_fault_plan(triples: Sequence[Tuple[str, int, str]],
+                        source: str = "DELPHI_FAULT_PLAN") -> Tuple[str, ...]:
+    """Returns the plan's site patterns that match NO registered guarded
+    site (:data:`KNOWN_SITES`) — such triples can never fire and used to
+    no-op silently. Logs a one-time warning per distinct (source,
+    unmatched-set) and bumps ``resilience.plan.unmatched`` once per
+    unmatched pattern, so a typo'd chaos plan is loud instead of a
+    false-green A/B run."""
+    unmatched = tuple(sorted(
+        {pat for pat, _nth, _kind in triples
+         if not any(fnmatch.fnmatchcase(s, pat) for s in KNOWN_SITES)}))
+    if unmatched:
+        key = (source, unmatched)
+        with _plan_lock:
+            first = key not in _validated_plans
+            if first:
+                _validated_plans.add(key)
+        if first:
+            for _ in unmatched:
+                counter_inc("resilience.plan.unmatched")
+            _logger.warning(
+                f"{source}: fault-plan site pattern(s) "
+                f"{', '.join(repr(p) for p in unmatched)} match no "
+                f"registered guarded site — these triples will never fire. "
+                f"Known sites: {', '.join(sorted(KNOWN_SITES))}")
+    return unmatched
 
 
 def reset_fault_state() -> None:
-    """Forgets fired triples and per-site call counts (tests / benches that
-    replay the same plan in one process)."""
+    """Forgets fired triples, per-site call counts, and validation warnings
+    (tests / benches that replay the same plan in one process)."""
     with _plan_lock:
         _plan_state.update(text=None, triples=(), fired=set(), calls={})
+        _validated_plans.clear()
 
 
 def _maybe_inject(site: str) -> None:
+    scope = current_scope()
+    if scope is not None:
+        # a request scope owns injection entirely: the process-global plan
+        # is NOT consulted, so one session's chaos never leaks into another
+        scope.maybe_inject(site)
+        return
     text = _fault_plan_text()
+    armed = None
     with _plan_lock:
         if text != _plan_state["text"]:
             _plan_state.update(text=text,
                                triples=parse_fault_plan(text) if text else (),
                                fired=set(), calls={})
+            armed = _plan_state["triples"]
         triples = _plan_state["triples"]
         if not triples:
             return
@@ -303,11 +400,112 @@ def _maybe_inject(site: str) -> None:
                 _plan_state["fired"].add(i)
                 hit = (kind, n)
                 break
+    if armed:
+        validate_fault_plan(armed)
     if hit is not None:
         counter_inc("resilience.injected")
         _logger.warning(f"fault plan: injecting {hit[0]} at {site} "
                         f"(call {hit[1]})")
         raise FaultInjected(hit[0], site, hit[1])
+
+
+# -- request scopes (per-session isolation for the serving plane) ------------
+
+_scope_tls = threading.local()
+
+
+class RequestScope:
+    """All per-request resilience state for one serving-plane session:
+    a private fault plan (fire-once + per-site entry counts), an absolute
+    deadline, abort and CPU-fallback latches, and an optional checkpoint-
+    directory override. While a scope is active on a thread the process-
+    global plan/latches are neither read nor written, so concurrent
+    requests cannot observe each other through this module."""
+
+    def __init__(self, request_id: str, *, fault_plan: str = "",
+                 deadline_s: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None) -> None:
+        self.request_id = str(request_id)
+        self.plan_triples = parse_fault_plan(fault_plan) if fault_plan else ()
+        if self.plan_triples:
+            validate_fault_plan(self.plan_triples,
+                                f"request {self.request_id} fault plan")
+        self.deadline_at = (time.monotonic() + float(deadline_s)
+                            if deadline_s is not None and float(deadline_s) > 0
+                            else None)
+        self.checkpoint_dir = checkpoint_dir
+        self.abort_reason: Optional[str] = None
+        self.cpu_latch: Dict[str, Any] = {"active": False, "site": None,
+                                          "device": None}
+        self._lock = threading.Lock()
+        self._fired: set = set()
+        self._calls: Dict[str, int] = {}
+
+    # deadline --------------------------------------------------------------
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (negative once past), or None when
+        the request has no deadline."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
+    def expired(self) -> bool:
+        rem = self.remaining_s()
+        return rem is not None and rem <= 0.0
+
+    # abort -----------------------------------------------------------------
+
+    def request_abort(self, reason: str) -> None:
+        """Arms this request's abort latch only — other in-flight sessions
+        keep running. Raised as RunAborted at the next seam entry / phase
+        boundary on the request's thread."""
+        if self.abort_reason is None:
+            self.abort_reason = str(reason)
+            counter_inc("resilience.aborts_requested")
+
+    # fault injection -------------------------------------------------------
+
+    def maybe_inject(self, site: str) -> None:
+        if not self.plan_triples:
+            return
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            hit = None
+            for i, (pat, nth, kind) in enumerate(self.plan_triples):
+                if i in self._fired:
+                    continue
+                if nth == n and fnmatch.fnmatchcase(site, pat):
+                    self._fired.add(i)
+                    hit = (kind, n)
+                    break
+        if hit is not None:
+            counter_inc("resilience.injected")
+            _logger.warning(
+                f"request {self.request_id} fault plan: injecting {hit[0]} "
+                f"at {site} (call {hit[1]})")
+            raise FaultInjected(hit[0], site, hit[1])
+
+
+def current_scope() -> Optional[RequestScope]:
+    """The RequestScope active on THIS thread, or None outside the serving
+    plane (the overwhelmingly common case: one attribute read)."""
+    return getattr(_scope_tls, "scope", None)
+
+
+@contextlib.contextmanager
+def request_scope(scope: RequestScope):
+    """Activates ``scope`` for the current thread. The scope is thread-
+    local by design: it covers every guarded seam entered on the request's
+    worker thread; helper threads the pipeline spawns internally fall back
+    to the (un-planned, un-latched) global state."""
+    prev = getattr(_scope_tls, "scope", None)
+    _scope_tls.scope = scope
+    try:
+        yield scope
+    finally:
+        _scope_tls.scope = prev
 
 
 # -- CPU fallback latch ------------------------------------------------------
@@ -321,10 +519,16 @@ def _current_phase() -> Optional[str]:
 
 
 def cpu_fallback_active() -> bool:
-    """True while the repeated-device-fault CPU latch holds. Scoped to the
-    phase that latched it: the latch self-clears when the recorder's current
-    phase moves on (the next phase gets the device back); without a recorder
-    it holds until clear_cpu_fallback()."""
+    """True while the repeated-device-fault CPU latch holds. Global latch:
+    scoped to the phase that latched it — it self-clears when the
+    recorder's current phase moves on (the next phase gets the device
+    back); without a recorder it holds until clear_cpu_fallback(). Scoped
+    latch (inside a RequestScope): holds for the remainder of the request —
+    the recorder's current phase is process-wide and races across
+    concurrent sessions, so it cannot scope a per-request latch."""
+    scope = current_scope()
+    if scope is not None:
+        return bool(scope.cpu_latch["active"])
     if not _cpu_latch["active"]:
         return False
     phase = _current_phase()
@@ -345,6 +549,10 @@ def _latch_cpu_fallback(site: str) -> bool:
         cpu = jax.devices("cpu")[0]
     except Exception:
         return False
+    scope = current_scope()
+    if scope is not None:
+        scope.cpu_latch.update(active=True, site=site, device=cpu)
+        return True
     _cpu_latch.update(active=True, phase=_current_phase(), site=site,
                       device=cpu)
     return True
@@ -352,8 +560,9 @@ def _latch_cpu_fallback(site: str) -> bool:
 
 def _cpu_device():
     import jax
-    return jax.default_device(_cpu_latch.get("device")
-                              or jax.devices("cpu")[0])
+    scope = current_scope()
+    latch = scope.cpu_latch if scope is not None else _cpu_latch
+    return jax.default_device(latch.get("device") or jax.devices("cpu")[0])
 
 
 # -- abort (watchdog checkpoint-and-abort) -----------------------------------
@@ -379,6 +588,22 @@ def clear_abort() -> None:
 
 
 def maybe_abort() -> None:
+    """Raises at a safe stopping point (seam entry / phase boundary) when
+    an abort or deadline applies. Inside a RequestScope only the scope's
+    latches count — the process-global abort (the watchdog's) is serviced
+    by the serving plane per-request, never broadcast through here, so one
+    wedged session cannot kill its neighbors."""
+    scope = current_scope()
+    if scope is not None:
+        if scope.abort_reason is not None:
+            raise RunAborted(f"run aborted: {scope.abort_reason}")
+        rem = scope.remaining_s()
+        if rem is not None and rem <= 0.0:
+            counter_inc("resilience.deadline_expired")
+            raise DeadlineExceeded(
+                f"request {scope.request_id} deadline exceeded "
+                f"({-rem:.3f}s past)")
+        return
     reason = _abort_state["reason"]
     if reason is not None:
         raise RunAborted(f"run aborted: {reason}")
@@ -435,11 +660,12 @@ def run_guarded(site: str, thunk: Callable[[], Any], *,
     is injectable so tier-1 tests run the schedule against a fake clock."""
     pol = policy or default_policy()
     do_sleep = sleep if sleep is not None else time.sleep
-    maybe_abort()
+    scope = current_scope()
     attempt = 0
     budget = pol.max_retries
     evicted = False
     while True:
+        maybe_abort()
         attempt += 1
         try:
             _maybe_inject(site)
@@ -460,6 +686,17 @@ def run_guarded(site: str, thunk: Callable[[], Any], *,
             if budget > 0:
                 budget -= 1
                 delay = pol.backoff_s(site, attempt)
+                if scope is not None:
+                    # clip the retry schedule to the request's remaining
+                    # deadline: sleeping past it would wedge a worker the
+                    # client has already abandoned
+                    rem = scope.remaining_s()
+                    if rem is not None and delay >= rem:
+                        counter_inc("resilience.deadline_clipped")
+                        raise DeadlineExceeded(
+                            f"request {scope.request_id}: {site} retry "
+                            f"backoff {delay:.3f}s exceeds remaining "
+                            f"deadline {max(rem, 0.0):.3f}s") from exc
                 counter_inc("resilience.retries")
                 histogram_observe("resilience.backoff_seconds", delay)
                 do_sleep(delay)
@@ -481,7 +718,9 @@ def run_guarded(site: str, thunk: Callable[[], Any], *,
                 evict()
                 budget = pol.max_retries
                 continue
-            if cpu_fallback and not _cpu_latch["active"] \
+            already_latched = (scope.cpu_latch["active"] if scope is not None
+                               else _cpu_latch["active"])
+            if cpu_fallback and not already_latched \
                     and _latch_cpu_fallback(site):
                 counter_inc("resilience.degrade.cpu_fallback")
                 _stamp_ledger("cpu_fallback", site, kind)
@@ -558,7 +797,13 @@ def note_fault(exc: BaseException, site: str) -> Optional[str]:
 
 def checkpoint_dir() -> Optional[str]:
     """``DELPHI_CHECKPOINT_DIR`` / ``repair.checkpoint.dir``, or None when
-    run-level phase checkpointing is off (the default)."""
+    run-level phase checkpointing is off (the default). An active
+    RequestScope's ``checkpoint_dir`` overrides both (empty string =
+    explicitly disabled for this request) so concurrent sessions never
+    collide on ``phase_*.pkl`` files."""
+    scope = current_scope()
+    if scope is not None and scope.checkpoint_dir is not None:
+        return scope.checkpoint_dir.strip() or None
     env = os.environ.get("DELPHI_CHECKPOINT_DIR")
     if env is not None and env.strip():
         return env.strip()
@@ -596,9 +841,11 @@ class PhaseCheckpointStore:
             with open(path, "rb") as f:
                 payload = pickle.load(f)
         except Exception as e:
-            _logger.warning(f"Ignoring unreadable phase checkpoint "
+            # truncated/corrupt pickle (killed mid-write before the atomic
+            # rename landed, disk corruption, wrong file): stale, recompute
+            _logger.warning(f"Ignoring corrupt phase checkpoint "
                             f"{path}: {e}")
-            counter_inc("resilience.checkpoint.misses")
+            counter_inc("resilience.checkpoint.corrupt")
             return None
         if not isinstance(payload, dict) \
                 or payload.get("version") != self.VERSION \

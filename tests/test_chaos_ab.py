@@ -1,0 +1,50 @@
+"""Chaos A/B smokes wired into tier-1 (fast, CPU-only, non-slow):
+
+- ``bench.chaos_smoke``: the batch resilience A/B — the deterministic
+  DELPHI_FAULT_PLAN run must survive via the retry + degradation ladder
+  and produce a repair frame bit-identical to the fault-free run.
+- ``bench.serve_chaos_smoke``: the service-mode A/B — N=2 concurrent
+  /repair requests over one warm RepairServer, one carrying a scoped
+  fault plan ending in an unabsorbable ``fatal``; the faulted request
+  fails with a structured error, the clean request stays bit-identical
+  to a solo run, and a follow-up request reuses the warm compile cache
+  (compile_cache.hits > 0) and table fingerprint cache.
+
+Both functions print one JSON metric line and return 0 on success; they
+manage (and restore) their own env knobs.
+"""
+
+import os
+
+import pytest
+
+import bench
+from delphi_tpu.parallel import resilience as rz
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state():
+    saved = {v: os.environ.get(v) for v in
+             ("DELPHI_FAULT_PLAN", "DELPHI_DOMAIN_DEVICE",
+              "DELPHI_RETRY_BASE_S", "DELPHI_COMPILE_CACHE_MIN_S",
+              "DELPHI_COMPILE_CACHE_DIR")}
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+
+
+def test_chaos_smoke_ab_bit_identical():
+    assert bench.chaos_smoke(bench._smoke_frame()) == 0
+
+
+def test_serve_chaos_concurrent_isolation():
+    assert bench.serve_chaos_smoke(bench._smoke_frame()) == 0

@@ -413,3 +413,205 @@ def test_provenance_ledger_records_degradation_notes(session):
     notes = led.notes()
     assert any(n["note"] == "resilience.shrink"
                and "domain.bucket" in n["detail"] for n in notes), notes
+
+
+# -- fault-plan validation (unmatched site patterns) --------------------------
+
+def test_validate_fault_plan_flags_unmatched_patterns(caplog):
+    import logging
+
+    triples = rz.parse_fault_plan("bogus.site:1:oom,xfer.*:1:oom")
+    with caplog.at_level(logging.WARNING,
+                         logger="delphi_tpu.parallel.resilience"):
+        unmatched = rz.validate_fault_plan(triples)
+        # second call with the same plan: warned once only
+        rz.validate_fault_plan(triples)
+    assert unmatched == ("bogus.site",)
+    warns = [r for r in caplog.records if "bogus.site" in r.getMessage()]
+    assert len(warns) == 1
+    assert "match no registered guarded site" in warns[0].getMessage()
+
+
+def test_global_plan_arms_with_validation_warning(caplog):
+    import logging
+
+    os.environ["DELPHI_FAULT_PLAN"] = "nonexistent.seam:1:oom"
+    with caplog.at_level(logging.WARNING,
+                         logger="delphi_tpu.parallel.resilience"):
+        rz._maybe_inject("xfer.upload")  # arms the plan -> validates
+        rz._maybe_inject("xfer.upload")
+    warns = [r for r in caplog.records
+             if "nonexistent.seam" in r.getMessage()]
+    assert len(warns) == 1
+
+
+def test_validate_fault_plan_accepts_wildcards_over_known_sites():
+    triples = rz.parse_fault_plan("domain.*:1:oom,backend.init:1:fatal")
+    assert rz.validate_fault_plan(triples) == ()
+
+
+def test_known_sites_match_source_literals():
+    """KNOWN_SITES must stay in sync with the run_guarded site literals in
+    the source tree (a new guarded seam that forgets to register would
+    silently escape fault-plan validation)."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(rz.__file__).resolve().parents[1]
+    pat = re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"')
+    found = {"backend.init"}  # injected by probe_backend, not run_guarded
+    for path in root.rglob("*.py"):
+        found.update(pat.findall(path.read_text()))
+    assert found == set(rz.KNOWN_SITES), (
+        f"KNOWN_SITES drift: source has {sorted(found)}, "
+        f"registry has {sorted(rz.KNOWN_SITES)}")
+
+
+# -- corrupt checkpoint classification ----------------------------------------
+
+def test_truncated_checkpoint_counts_corrupt_and_recomputes(tmp_path):
+    """A checkpoint truncated mid-write (kill before the atomic rename's
+    source was fully flushed, disk corruption) must classify as stale —
+    recompute, resilience.checkpoint.corrupt — never raise UnpicklingError
+    into the run."""
+    from delphi_tpu import observability as obs
+
+    store = rz.PhaseCheckpointStore(str(tmp_path), {"content": "abc"})
+    store.save("detect", {"cells": [1, 2, 3]})
+    path = tmp_path / "phase_detect.pkl"
+    blob = path.read_bytes()
+    path.write_bytes(blob[:len(blob) // 2])  # truncate mid-pickle
+
+    rec = obs.start_recording("t_corrupt")
+    try:
+        assert store.load("detect") is None  # recompute, no raise
+    finally:
+        obs.stop_recording(rec)
+    counters = rec.registry.snapshot()["counters"]
+    assert counters.get("resilience.checkpoint.corrupt") == 1
+    assert "resilience.checkpoint.misses" not in counters
+
+
+# -- request scopes (serving-plane isolation) ---------------------------------
+
+def test_request_scope_plan_is_thread_local():
+    """A scoped fault plan fires only on the scope's thread; a concurrent
+    unscoped thread entering the same site is untouched."""
+    import threading
+
+    scope = rz.RequestScope("r1", fault_plan="domain.bucket:1:oom")
+    errors = []
+    fired = []
+
+    def scoped():
+        with rz.request_scope(scope):
+            try:
+                rz._maybe_inject("domain.bucket")
+            except rz.FaultInjected as e:
+                fired.append(e.kind)
+
+    def unscoped():
+        try:
+            rz._maybe_inject("domain.bucket")
+        except BaseException as e:  # pragma: no cover - failure evidence
+            errors.append(e)
+
+    threads = [threading.Thread(target=scoped),
+               threading.Thread(target=unscoped)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fired == ["oom"] and errors == []
+
+
+def test_request_scope_shadows_global_plan():
+    os.environ["DELPHI_FAULT_PLAN"] = "xfer.upload:1:oom"
+    scope = rz.RequestScope("r1")  # no plan of its own
+    with rz.request_scope(scope):
+        rz._maybe_inject("xfer.upload")  # global plan NOT consulted
+    with pytest.raises(rz.FaultInjected):
+        rz._maybe_inject("xfer.upload")  # outside the scope it fires
+
+
+def test_scope_deadline_raises_at_seam():
+    scope = rz.RequestScope("r1", deadline_s=0.0001)
+    time.sleep(0.01)
+    with rz.request_scope(scope):
+        with pytest.raises(rz.DeadlineExceeded):
+            rz.maybe_abort()
+    rz.maybe_abort()  # no scope, no global abort: fine
+
+
+def test_deadline_exceeded_is_unclassifiable():
+    assert rz.classify_fault(rz.DeadlineExceeded("late")) is None
+    assert isinstance(rz.DeadlineExceeded("late"), BaseException)
+    assert not isinstance(rz.DeadlineExceeded("late"), Exception)
+
+
+def test_run_guarded_clips_backoff_to_scope_deadline():
+    """A retry whose backoff would sleep past the request deadline raises
+    DeadlineExceeded instead of wedging the worker."""
+    slept, sleep = _fake_clock()
+    scope = rz.RequestScope("r1", fault_plan="s:1:transient",
+                            deadline_s=5.0)
+    pol = rz.RetryPolicy(max_retries=2, base_s=100.0, cap_s=100.0)
+    with rz.request_scope(scope):
+        with pytest.raises(rz.DeadlineExceeded):
+            rz.run_guarded("s", lambda: 1, policy=pol, sleep=sleep)
+    assert slept == []  # clipped BEFORE sleeping
+
+
+def test_run_guarded_honors_scope_abort_between_attempts():
+    scope = rz.RequestScope("r1", fault_plan="s:1:transient,s:2:transient")
+    slept, sleep = _fake_clock()
+
+    def sleep_and_abort(s):
+        slept.append(s)
+        scope.request_abort("drain")
+
+    pol = rz.RetryPolicy(max_retries=2, base_s=0.0)
+    with rz.request_scope(scope):
+        with pytest.raises(rz.RunAborted):
+            rz.run_guarded("s", lambda: 1, policy=pol,
+                           sleep=sleep_and_abort)
+    assert len(slept) == 1  # aborted at the next attempt's seam check
+
+
+def test_scope_cpu_latch_does_not_leak():
+    scope = rz.RequestScope("r1")
+    with rz.request_scope(scope):
+        assert rz._latch_cpu_fallback("s")
+        assert rz.cpu_fallback_active()
+    assert not rz.cpu_fallback_active()  # global latch untouched
+    assert not rz._cpu_latch["active"]
+
+
+def test_scope_abort_does_not_touch_global_state():
+    scope = rz.RequestScope("r1")
+    scope.request_abort("drain")
+    with rz.request_scope(scope):
+        with pytest.raises(rz.RunAborted):
+            rz.maybe_abort()
+    assert rz.abort_requested() is None
+    rz.maybe_abort()
+
+
+def test_scoped_request_ignores_global_abort():
+    rz.request_abort("watchdog stall")
+    scope = rz.RequestScope("r1")
+    with rz.request_scope(scope):
+        rz.maybe_abort()  # global abort is not the scope's problem
+    with pytest.raises(rz.RunAborted):
+        rz.maybe_abort()
+
+
+def test_scope_checkpoint_dir_override(tmp_path):
+    os.environ["DELPHI_CHECKPOINT_DIR"] = str(tmp_path / "global")
+    scope = rz.RequestScope("r1", checkpoint_dir=str(tmp_path / "scoped"))
+    with rz.request_scope(scope):
+        assert rz.checkpoint_dir() == str(tmp_path / "scoped")
+    assert rz.checkpoint_dir() == str(tmp_path / "global")
+    disabled = rz.RequestScope("r2", checkpoint_dir="")
+    with rz.request_scope(disabled):
+        assert rz.checkpoint_dir() is None

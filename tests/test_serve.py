@@ -1,0 +1,226 @@
+"""Serving-plane tests: admission control (queue shedding, drain
+rejection, bad deadlines), the HTTP surface (/healthz, /metrics with
+pre-seeded serve.* and resilience.* counters), per-request deadline
+expiry mapping to 504 with the worker reclaimed, warm-cache reuse across
+requests, and graceful drain semantics.
+
+The heavyweight concurrent-isolation A/B (two threaded /repair requests,
+one carrying a scoped fault plan; clean request bit-identical to a solo
+run, warm compile cache reused) lives in bench.serve_chaos_smoke and is
+exercised by tests/test_chaos_ab.py.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import urllib.request
+
+import pytest
+
+from delphi_tpu.observability.serve import Rejection, RepairServer
+from delphi_tpu.parallel import resilience as rz
+
+_ENV_VARS = (
+    "DELPHI_FAULT_PLAN", "DELPHI_SERVE_WORKERS", "DELPHI_SERVE_QUEUE_DEPTH",
+    "DELPHI_SERVE_DEADLINE_S", "DELPHI_SERVE_MAX_RSS_GB",
+    "DELPHI_SERVE_STALL_SHED_S", "DELPHI_SERVE_CACHE_DIR",
+    "DELPHI_SERVE_PROVENANCE_DIR", "DELPHI_COMPILE_CACHE_DIR",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve_state():
+    saved = {v: os.environ.get(v) for v in _ENV_VARS}
+    for v in _ENV_VARS:
+        os.environ.pop(v, None)
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+    rz.reset_fault_state()
+    rz.clear_abort()
+    rz.clear_cpu_fallback()
+
+
+def _payload(**overrides):
+    """A tiny repairable table (nulls in c1 for the NullErrorDetector)."""
+    n = 24
+    table = {
+        "tid": [str(i) for i in range(n)],
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [None if i % 11 == 0 else str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    }
+    payload = {"table": table, "row_id": "tid"}
+    payload.update(overrides)
+    return payload
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _post(port, path, body, timeout=240):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), e.headers
+
+
+# -- admission control (no started server needed) -----------------------------
+
+def test_full_queue_sheds_with_retry_after():
+    srv = RepairServer(workers=1, queue_depth=1)
+    srv.submit(_payload())  # fills the only slot (no worker is draining it)
+    with pytest.raises(Rejection) as ei:
+        srv.submit(_payload())
+    assert ei.value.status == 429
+    assert ei.value.retry_after_s is not None
+    assert "queue full" in ei.value.reason
+
+
+def test_draining_server_rejects_503():
+    srv = RepairServer(workers=1, queue_depth=4)
+    srv.begin_drain()
+    with pytest.raises(Rejection) as ei:
+        srv.submit(_payload())
+    assert ei.value.status == 503
+    assert "draining" in ei.value.reason
+
+
+def test_bad_deadline_rejects_400():
+    srv = RepairServer(workers=1, queue_depth=4)
+    with pytest.raises(Rejection) as ei:
+        srv.submit(_payload(deadline_s="soon"))
+    assert ei.value.status == 400
+
+
+def test_rss_admission_limit_sheds():
+    # any live process exceeds a 1-byte RSS budget
+    os.environ["DELPHI_SERVE_MAX_RSS_GB"] = "0.000000001"
+    srv = RepairServer(workers=1, queue_depth=4)
+    with pytest.raises(Rejection) as ei:
+        srv.submit(_payload())
+    assert ei.value.status == 429
+    assert "RSS" in ei.value.reason
+
+
+def test_admission_knobs_read_env():
+    os.environ["DELPHI_SERVE_WORKERS"] = "3"
+    os.environ["DELPHI_SERVE_QUEUE_DEPTH"] = "17"
+    os.environ["DELPHI_SERVE_DEADLINE_S"] = "12.5"
+    srv = RepairServer()
+    assert srv.workers == 3
+    assert srv.queue_depth == 17
+    assert srv.default_deadline_s == 12.5
+
+
+# -- the live service ---------------------------------------------------------
+
+def test_service_lifecycle_deadlines_warm_cache_and_drain():
+    """One server, end to end: /metrics pre-seeds the serve.* and
+    resilience.* counter families; a request with a tiny deadline maps to
+    504 (DeadlineExceeded mid-phase or in-queue) and the worker is
+    reclaimed; the next request on the same table succeeds and warms the
+    fingerprint cache; drain stops admission and the server winds down."""
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_test_")
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        port = srv.port
+        status, body = _get(port, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok"
+        assert health["workers"] == 1
+
+        # pre-seeded counter families: visible at zero before any request
+        status, metrics = _get(port, "/metrics")
+        assert status == 200
+        for name in ("delphi_serve_requests", "delphi_serve_shed",
+                     "delphi_serve_deadline_expired",
+                     "delphi_resilience_retries",
+                     "delphi_resilience_checkpoint_corrupt",
+                     "delphi_resilience_plan_unmatched"):
+            assert name in metrics, f"{name} not pre-seeded on /metrics"
+
+        # deadline expiry -> 504, structured status, worker reclaimed
+        status, resp, _ = _post(
+            port, "/repair", _payload(deadline_s=0.05, request_id="late"))
+        assert status == 504
+        assert resp["status"] == "deadline_exceeded"
+        assert resp["request_id"] == "late"
+
+        # the reclaimed worker serves the next request on the same table
+        status, resp, _ = _post(port, "/repair", _payload(request_id="ok1"))
+        assert status == 200 and resp["status"] == "ok"
+        assert resp["rows"] > 0
+        frame1 = resp["frame"]
+
+        # warm path: same fingerprint -> table cache hit, identical frame
+        status, resp, _ = _post(port, "/repair", _payload(request_id="ok2"))
+        assert status == 200 and resp["frame"] == frame1
+
+        status, metrics = _get(port, "/metrics")
+        # ok2 is always a fingerprint-cache hit; ok1 is too when the "late"
+        # request got far enough to resolve the table before expiring
+        hits = [line.split()[1] for line in metrics.splitlines()
+                if line.startswith("delphi_serve_table_cache_hits ")]
+        assert hits and float(hits[0]) >= 1
+        assert "delphi_serve_deadline_expired 1" in metrics
+
+        # drain: admission closes with Retry-After, in-flight (none) drains
+        status, resp, headers = _post(port, "/drain", {})
+        assert status == 200
+        status, resp, headers = _post(port, "/repair", _payload())
+        assert status == 503
+        assert headers.get("Retry-After") is not None
+        srv.drain(grace_s=10)
+        assert srv.wait(timeout=10)
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    # no serve threads may outlive the server
+    leftover = [t.name for t in threading.enumerate()
+                if t.name.startswith("delphi-serve")]
+    assert leftover == []
+
+
+def test_drain_completes_in_flight_request():
+    """begin_drain while a request is in flight: admission is closed
+    immediately, but the in-flight request finishes (or checkpoints) —
+    drain never drops accepted work on the floor."""
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_test_")
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        job = srv.submit(_payload(request_id="inflight"))
+        srv.begin_drain()
+        with pytest.raises(Rejection):
+            srv.submit(_payload(request_id="toolate"))
+        srv.drain(grace_s=120)
+        assert job.done.is_set()
+        # completed (200) or abort-checkpointed at the grace boundary (503
+        # with the resumable flag) — never silently dropped
+        if job.status_code == 200:
+            assert job.response["status"] == "ok"
+        else:
+            assert job.status_code == 503
+            assert job.response["status"] == "aborted"
+            assert job.response["resumable"] is True
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)

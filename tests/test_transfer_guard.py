@@ -86,3 +86,22 @@ def test_launch_modules_reference_the_resilience_seam():
                  MODELS_DIR / "gbdt.py"):
         assert "run_guarded" in path.read_text(), (
             f"{path} no longer references the resilience launch seam")
+
+
+def test_guarded_site_names_are_registered():
+    """Every `run_guarded("<site>", ...)` literal in the source tree must be
+    a member of resilience.KNOWN_SITES — fault-plan validation (the one-time
+    "pattern matches no registered guarded site" warning at arm time) is
+    only trustworthy while the registry is complete. A new guarded seam
+    must register its site name."""
+    from delphi_tpu.parallel.resilience import KNOWN_SITES
+
+    pkg_root = OPS_DIR.parent
+    pat = re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"')
+    found = set()
+    for path in sorted(pkg_root.rglob("*.py")):
+        found.update(pat.findall(path.read_text()))
+    unregistered = found - set(KNOWN_SITES)
+    assert not unregistered, (
+        f"run_guarded sites missing from resilience.KNOWN_SITES: "
+        f"{sorted(unregistered)}")
