@@ -330,7 +330,10 @@ def smoke() -> int:
     rc = transfer_smoke(df)
     if rc:
         return rc
-    return chaos_smoke(df)
+    rc = chaos_smoke(df)
+    if rc:
+        return rc
+    return incremental_smoke()
 
 
 def _smoke_frame():
@@ -530,6 +533,166 @@ def chaos() -> int:
     fault plan, bit-identical A/B (see chaos_smoke)."""
     _force_cpu_backend()
     return chaos_smoke(_smoke_frame())
+
+
+def _incremental_frames(n: int = 64):
+    """Deterministic base + appended frame pair for the incremental A/B.
+
+    Rows belong to one of 32 groups keyed by ``c0``; ``c1`` and ``c3`` are
+    pure functions of the group id, so every column keeps a small, scale-
+    independent domain (the repair model must be able to LEARN c0 -> c1 at
+    any ``n`` — at unbounded key cardinality its predictions degrade into
+    noise that no two training sets agree on, and the bit-identity this
+    A/B asserts becomes unachievable). Every 11th base row nulls ``c1``
+    (the errors). The denial constraint the A/B declares —
+    ``EQ(t1.c0,t2.c0) & IQ(t1.c3,t2.c3)`` — NEVER fires (``c3`` is
+    group-consistent and never null), so it cannot perturb the error mask
+    between the subset and full runs; its cross-tuple EQ key is what makes
+    the delta planner pull a touched group's prior rows into the plan. The
+    appended slice (~10% of ``n``) lands entirely in groups 0-3 — a mix of
+    NULL repairs and clean rows — so expansion pulls exactly those four
+    groups (~n/8 rows) and the rest of the base table splices through
+    untouched."""
+    import pandas as pd
+
+    def row(i, gid, null_c1=False):
+        return {"tid": str(i), "c0": f"g{gid}",
+                "c1": None if null_c1 else f"v{gid % 7}",
+                "c2": str((i * 7) % 5), "c3": f"w{gid % 5}"}
+
+    base = pd.DataFrame(
+        [row(i, i % 32, null_c1=(i % 11 == 0)) for i in range(n)])
+    extra = [row(n + j, j % 4, null_c1=(j % 3 == 0))
+             for j in range(max(4, n // 10))]
+    appended = pd.concat([base, pd.DataFrame(extra)], ignore_index=True)
+    return base, appended
+
+
+def incremental_smoke(n: int = 64, min_speedup: float = 0.0) -> int:
+    """Incremental repair plane A/B on a clean-append workload: run 1
+    repairs the base table with `repair.incremental` on (no manifest yet →
+    counted fallback that populates the snapshot), run 2 repairs the
+    appended table incrementally against that snapshot, run 3 repairs the
+    appended table from scratch. The delta run must produce a BIT-IDENTICAL
+    frame to the from-scratch run while scanning strictly fewer rows in
+    detection and scoring strictly fewer cells in domain analysis (the
+    planned-subset proof), reusing at least one frozen model, and emitting
+    the `incremental.*` counters. `min_speedup > 0` additionally gates on
+    from-scratch/delta wall time (used by the standalone entry at larger
+    `n`). Prints one JSON line; exit code 1 on failure."""
+    import tempfile
+    import time
+
+    import pandas as pd
+
+    from delphi_tpu import ConstraintErrorDetector, NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.session import get_session
+
+    base, appended = _incremental_frames(n)
+    snapshot_dir = tempfile.mkdtemp(prefix="delphi_incr_smoke_")
+    constraints = "t1&t2&EQ(t1.c0,t2.c0)&IQ(t1.c3,t2.c3)"
+
+    # in-memory provenance ledger: the splice stamps per-cell decisions as
+    # reused/recomputed, and the A/B asserts those counts are real
+    prev_prov = os.environ.get("DELPHI_PROVENANCE_PATH")
+    os.environ["DELPHI_PROVENANCE_PATH"] = ":memory:"
+
+    def one_run(tag: str, frame, incremental: bool) -> dict:
+        _heartbeat(f"incremental smoke {tag} run ({len(frame)} rows)")
+        name = f"incr_smoke_{tag}"
+        get_session().register(name, frame.copy())
+        rec = obs.start_recording(f"bench.incremental.{tag}")
+        t0 = time.perf_counter()
+        try:
+            model = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([
+                    NullErrorDetector(),
+                    ConstraintErrorDetector(constraints=constraints),
+                ])
+            if incremental:
+                model = model \
+                    .option("repair.incremental", "true") \
+                    .option("repair.snapshot.dir", snapshot_dir)
+            out = model.run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+        counters = rec.registry.snapshot()["counters"]
+        return {
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "rows_scanned": int(counters.get("detect.rows_scanned", 0)),
+            "cells_scored": int(counters.get("domain.cells_scored", 0)),
+            "incremental": {k: int(v) for k, v in counters.items()
+                            if k.startswith("incremental.")},
+            "summary": getattr(rec, "incremental", None),
+            "frame": out,
+        }
+
+    try:
+        populate = one_run("populate", base, incremental=True)
+        delta = one_run("delta", appended, incremental=True)
+        fresh = one_run("fresh", appended, incremental=False)
+    finally:
+        if prev_prov is None:
+            os.environ.pop("DELPHI_PROVENANCE_PATH", None)
+        else:
+            os.environ["DELPHI_PROVENANCE_PATH"] = prev_prov
+
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(delta["frame"], fresh["frame"])
+    except AssertionError:
+        frames_equal = False
+    repairs = int(len(fresh["frame"]))
+    for r in (populate, delta, fresh):
+        del r["frame"]
+
+    inc = delta["incremental"]
+    speedup = fresh["elapsed_s"] / delta["elapsed_s"] \
+        if delta["elapsed_s"] > 0 else 0.0
+    summary = delta["summary"] or {}
+    mode = summary.get("mode")
+    ok = frames_equal \
+        and mode == "delta" \
+        and summary.get("rows_expanded", 0) > 0 \
+        and populate["incremental"].get("incremental.fallback", 0) == 1 \
+        and inc.get("incremental.fallback", 0) == 0 \
+        and inc.get("incremental.rows_replanned", 0) > 0 \
+        and inc.get("incremental.rows_replanned", 0) < len(appended) \
+        and inc.get("incremental.models_reused", 0) >= 1 \
+        and inc.get("incremental.columns_reused", 0) >= 1 \
+        and inc.get("incremental.cells_spliced_reused", 0) > 0 \
+        and delta["rows_scanned"] < fresh["rows_scanned"] \
+        and delta["cells_scored"] < fresh["cells_scored"] \
+        and speedup >= min_speedup
+    print(json.dumps({
+        "metric": "incremental_smoke", "value": round(speedup, 2),
+        "unit": "x speedup (fresh/delta)", "vs_baseline": None, "ok": ok,
+        "rows": len(appended), "repairs": repairs,
+        "frames_equal": frames_equal, "mode": mode,
+        "populate": populate, "delta": delta, "fresh": fresh,
+    }), flush=True)
+    if not ok:
+        print("incremental smoke FAILED: delta run must be bit-identical to "
+              "the from-scratch run on strictly less detection/domain work "
+              f"(frames_equal={frames_equal}, mode={mode}, "
+              f"speedup={speedup:.2f} vs min {min_speedup}, "
+              f"delta={delta}, fresh={fresh})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def incremental() -> int:
+    """Standalone `bench.py --incremental` entry: CPU backend, full-vs-delta
+    A/B at a base size where replanning ~10% of the rows must win at least
+    2x of the from-scratch wall time (see incremental_smoke)."""
+    _force_cpu_backend()
+    return incremental_smoke(
+        n=int(os.environ.get("DELPHI_BENCH_INCR_ROWS", "8192")),
+        min_speedup=float(os.environ.get("DELPHI_BENCH_INCR_SPEEDUP", "2.0")))
 
 
 # The scoped service-mode plan: one transient upload fault (exercises the
@@ -917,6 +1080,14 @@ def main() -> None:
                              "deterministic DELPHI_FAULT_PLAN, asserting "
                              "bit-identical frames and matching "
                              "resilience.* counters; exits 1 on failure")
+    parser.add_argument("--incremental", dest="incremental",
+                        action="store_true",
+                        help="incremental repair plane A/B on the CPU "
+                             "backend: snapshot-populate, then repair an "
+                             "appended table via delta planning vs from "
+                             "scratch, asserting bit-identical frames, "
+                             "subset detection/domain work, and >=2x "
+                             "wall-clock speedup; exits 1 on failure")
     parser.add_argument("--serve-chaos", dest="serve_chaos",
                         action="store_true",
                         help="service-mode chaos A/B on the CPU backend: "
@@ -934,6 +1105,9 @@ def main() -> None:
 
     if args.chaos:
         sys.exit(chaos())
+
+    if args.incremental:
+        sys.exit(incremental())
 
     if args.serve_chaos:
         sys.exit(serve_chaos())

@@ -1,0 +1,35 @@
+"""Incremental repair plane: snapshot manifests, delta planning, and
+drift-gated model reuse.
+
+Connects the pieces earlier subsystems left on the table — per-cell
+provenance with drift gates, fingerprint-keyed device-resident codes,
+phase checkpoints, and the warm serving process — into "re-repair only
+what changed":
+
+* :mod:`~delphi_tpu.incremental.manifest` — snapshot manifests persisting
+  per-column content fingerprints plus chunked row-block fingerprints
+  under ``DELPHI_SNAPSHOT_DIR`` / ``repair.snapshot.dir`` (atomic,
+  versioned, mergeable across hosts like run reports).
+* :mod:`~delphi_tpu.incremental.planner` — diffs an incoming table against
+  the manifest into clean/dirty columns and unchanged/updated/appended
+  rows, then expands the dirty row set through the constraint dependency
+  graph (:mod:`~delphi_tpu.incremental.depgraph`).
+* :mod:`~delphi_tpu.incremental.executor` — threads the plan through the
+  existing phases: detection/domain/training re-run only on the planned
+  row subset, frozen per-attribute models are reused when the drift gate
+  (PSI over the snapshot value histograms) says the attribute hasn't
+  moved, and the new per-cell decisions splice into the prior result frame
+  and provenance ledger (each spliced cell stamped ``reused`` /
+  ``recomputed``).
+
+See docs/source/incremental.rst.
+"""
+
+from delphi_tpu.incremental.executor import (  # noqa: F401
+    incremental_requested, run_incremental, snapshot_dir_for,
+)
+from delphi_tpu.incremental.manifest import (  # noqa: F401
+    MANIFEST_VERSION, build_manifest, load_manifest, load_state,
+    merge_manifests, write_snapshot,
+)
+from delphi_tpu.incremental.planner import DeltaPlan, plan_delta  # noqa: F401

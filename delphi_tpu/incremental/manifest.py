@@ -1,0 +1,252 @@
+"""Snapshot manifests: content identity of an encoded table, persisted.
+
+A snapshot directory holds two files:
+
+* ``manifest.json`` — versioned, JSON, atomic: per-column whole-content
+  fingerprints, chunked row-block fingerprints (``block_rows`` rows per
+  block), capped per-column value histograms (the drift gate's baseline),
+  and the row-id column's fingerprints. Everything the delta planner needs
+  to diff an incoming table WITHOUT touching the prior data.
+* ``state.pkl`` — pickle, atomic: the prior repair-candidates frame, the
+  trained per-attribute models, and the provenance ledger entries the
+  executor splices reused cells from. Same trust boundary as the model /
+  phase checkpoints (plain pickles — point the directory only at files
+  this process wrote).
+
+Fingerprints hash the DECODED value strings (vocab spellings), so they are
+invariant under column reorder (columns key by name), vocab permutation
+(two encodings of the same data always agree), and block-size changes (the
+whole-column fingerprint never looks at block boundaries).
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from delphi_tpu.table import EncodedTable
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+MANIFEST_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+STATE_FILE = "state.pkl"
+
+# rows per fingerprint block: DELPHI_SNAPSHOT_BLOCK_ROWS /
+# repair.snapshot.block_rows (block granularity of the updated-row diff)
+_DEFAULT_BLOCK_ROWS = 4096
+# value histograms keep the top-K values by count; the tail folds into
+# "__other__" so a manifest never grows with the domain
+_HISTOGRAM_TOP_K = 64
+
+_NULL_SENTINEL = "\x00NULL"
+_SEP = "\x1f"
+
+
+def block_rows_setting() -> int:
+    """``DELPHI_SNAPSHOT_BLOCK_ROWS`` env over the
+    ``repair.snapshot.block_rows`` session conf (default 4096)."""
+    env = os.environ.get("DELPHI_SNAPSHOT_BLOCK_ROWS")
+    if env:
+        return max(1, int(env))
+    from delphi_tpu.session import get_session
+    conf = get_session().conf.get("repair.snapshot.block_rows")
+    return max(1, int(conf)) if conf else _DEFAULT_BLOCK_ROWS
+
+
+def _sha1(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+
+
+def value_strings(table: EncodedTable, name: str) -> List[str]:
+    """Per-row canonical value spellings of one column (NULL -> sentinel):
+    the byte stream both the whole-column and the block fingerprints hash."""
+    if name == table.row_id:
+        return [str(v) for v in table.row_id_values.tolist()]
+    decoded = table.column(name).decode()
+    return [_NULL_SENTINEL if v is None else str(v) for v in decoded.tolist()]
+
+
+def fingerprint_values(values: List[str], block: int) -> Tuple[str, List[str]]:
+    """(whole-column sha1, per-block sha1 list). The whole fingerprint hashes
+    the full value stream directly — block boundaries never enter it — so it
+    is stable across ``block_rows`` settings; a block fingerprint depends
+    only on its own rows."""
+    whole = _sha1(_SEP.join(values))
+    blocks = [_sha1(_SEP.join(values[lo:lo + block]))
+              for lo in range(0, len(values), block)]
+    return whole, blocks
+
+
+def value_histogram(table: EncodedTable, name: str) -> Dict[str, Any]:
+    """Capped value-count histogram of one column — the snapshot-side
+    baseline the planner's PSI drift gate compares future runs against."""
+    col = table.column(name)
+    codes = col.codes
+    valid = codes >= 0
+    counts = np.bincount(codes[valid], minlength=len(col.vocab)) \
+        if valid.any() else np.zeros(len(col.vocab), dtype=np.int64)
+    # deterministic top-K: count desc, then spelling asc
+    order = sorted(range(len(counts)), key=lambda i: (-int(counts[i]),
+                                                      str(col.vocab[i])))
+    top = [i for i in order[:_HISTOGRAM_TOP_K] if counts[i] > 0]
+    values = {str(col.vocab[i]): int(counts[i]) for i in top}
+    other = int(counts.sum()) - sum(values.values())
+    return {"values": values, "other": other,
+            "null": int((~valid).sum())}
+
+
+def build_manifest(table: EncodedTable, options_digest: str = "",
+                   mode: str = "repair_candidates",
+                   block: Optional[int] = None) -> Dict[str, Any]:
+    """Builds the manifest dict for an encoded table (no I/O)."""
+    from delphi_tpu.parallel.resilience import fingerprint_digest
+    block = block or block_rows_setting()
+    rid_values = value_strings(table, table.row_id)
+    rid_whole, rid_blocks = fingerprint_values(rid_values, block)
+    columns: Dict[str, Any] = {}
+    for c in table.columns:
+        vals = value_strings(table, c.name)
+        whole, blocks = fingerprint_values(vals, block)
+        columns[c.name] = {
+            "kind": c.kind,
+            "value_sha1": whole,
+            "block_sha1": blocks,
+            "histogram": value_histogram(table, c.name),
+        }
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "row_id": {"name": table.row_id, "kind": table.row_id_kind,
+                   "value_sha1": rid_whole, "block_sha1": rid_blocks},
+        "n_rows": int(table.n_rows),
+        "block_rows": int(block),
+        "columns": columns,
+        "options_digest": options_digest,
+        "mode": mode,
+        "merged": False,
+    }
+    manifest["snapshot_id"] = fingerprint_digest(manifest)[:16]
+    return manifest
+
+
+def merge_manifests(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Merges two ROW-SHARDED manifests of one logical table (multi-host:
+    each process snapshots its shard, ranks merge like run reports). Block
+    fingerprint lists concatenate in argument order and histograms sum; the
+    whole-column fingerprints combine as a hash chain, so a merged manifest
+    supports block-level diffing and drift gating but not the whole-column
+    short-circuit (``merged`` is set and the planner knows)."""
+    if a.get("version") != b.get("version") \
+            or a.get("block_rows") != b.get("block_rows") \
+            or a["row_id"]["name"] != b["row_id"]["name"] \
+            or set(a["columns"]) != set(b["columns"]):
+        raise ValueError("manifests are not shards of one table "
+                         "(version/block_rows/row_id/columns differ)")
+    from delphi_tpu.parallel.resilience import fingerprint_digest
+    out: Dict[str, Any] = {
+        "version": a["version"],
+        "row_id": {
+            "name": a["row_id"]["name"], "kind": a["row_id"]["kind"],
+            "value_sha1": _sha1(a["row_id"]["value_sha1"]
+                                + b["row_id"]["value_sha1"]),
+            "block_sha1": list(a["row_id"]["block_sha1"])
+            + list(b["row_id"]["block_sha1"]),
+        },
+        "n_rows": int(a["n_rows"]) + int(b["n_rows"]),
+        "block_rows": a["block_rows"],
+        "columns": {},
+        "options_digest": a.get("options_digest", ""),
+        "mode": a.get("mode", "repair_candidates"),
+        "merged": True,
+    }
+    for name, ca in a["columns"].items():
+        cb = b["columns"][name]
+        hist = {"values": dict(ca["histogram"]["values"]),
+                "other": int(ca["histogram"]["other"])
+                + int(cb["histogram"]["other"]),
+                "null": int(ca["histogram"]["null"])
+                + int(cb["histogram"]["null"])}
+        for v, n in cb["histogram"]["values"].items():
+            hist["values"][v] = hist["values"].get(v, 0) + int(n)
+        out["columns"][name] = {
+            "kind": ca["kind"],
+            "value_sha1": _sha1(ca["value_sha1"] + cb["value_sha1"]),
+            "block_sha1": list(ca["block_sha1"]) + list(cb["block_sha1"]),
+            "histogram": hist,
+        }
+    out["snapshot_id"] = fingerprint_digest(out)[:16]
+    return out
+
+
+# -- persistence --------------------------------------------------------------
+
+def _atomic_write(path: str, data: bytes) -> None:
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".snap_", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_snapshot(directory: str, manifest: Dict[str, Any],
+                   state: Dict[str, Any]) -> None:
+    """Persists a snapshot atomically: the state pickle lands before the
+    manifest, so a reader never sees a manifest pointing at a half-written
+    state (a kill between the two leaves the PREVIOUS snapshot's manifest
+    paired with the new state — detected by the fingerprint diff, which
+    falls back to a full run)."""
+    _atomic_write(os.path.join(directory, STATE_FILE), pickle.dumps(state))
+    _atomic_write(os.path.join(directory, MANIFEST_FILE),
+                  json.dumps(manifest, sort_keys=True, indent=1).encode())
+    _logger.info(f"Snapshot {manifest.get('snapshot_id')} written to "
+                 f"{directory} ({manifest.get('n_rows')} rows)")
+
+
+def load_manifest(directory: str) -> Optional[Dict[str, Any]]:
+    """Loads a manifest, or None when missing/corrupt/unknown-version (the
+    caller falls back to a full run either way)."""
+    path = os.path.join(directory, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r") as f:
+            manifest = json.load(f)
+    except Exception as e:
+        _logger.warning(f"Ignoring corrupt snapshot manifest {path}: {e}")
+        return None
+    if not isinstance(manifest, dict) \
+            or manifest.get("version") != MANIFEST_VERSION:
+        _logger.warning(f"Ignoring snapshot manifest {path}: "
+                        "unknown version")
+        return None
+    return manifest
+
+
+def load_state(directory: str) -> Optional[Dict[str, Any]]:
+    """Loads the state pickle (prior frame / models / ledger entries), or
+    None when missing or unreadable."""
+    path = os.path.join(directory, STATE_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+    except Exception as e:
+        _logger.warning(f"Ignoring corrupt snapshot state {path}: {e}")
+        return None
+    return state if isinstance(state, dict) else None

@@ -12,6 +12,11 @@ live telemetry; `--provenance-out` records the per-cell repair provenance
 ledger; `--baseline-report` runs the cross-run drift gate against a prior
 run report (exit code 3 when `--drift-fail-over` trips).
 
+Incremental mode: `--incremental --snapshot-dir D` diffs the input against
+the snapshot manifest in D, repairs only the delta (reusing undrifted
+per-attribute models and prior per-cell decisions), and updates the
+snapshot; the first run populates it. See docs/source/incremental.rst.
+
 Service mode: `--serve [--serve-port P] [--serve-cache-dir D]` skips the
 batch arguments entirely and runs the persistent repair service
 (`delphi_tpu/observability/serve.py`): POST /repair, GET /metrics //healthz
@@ -128,6 +133,26 @@ def main(argv=None) -> int:
                              "injected at the guarded launch seam (see "
                              "docs/source/robustness.rst). Equivalent to "
                              "DELPHI_FAULT_PLAN / repair.fault.plan")
+    parser.add_argument("--incremental", dest="incremental",
+                        action="store_true",
+                        help="delta-aware repair against the snapshot in "
+                             "--snapshot-dir: diff the input table vs the "
+                             "stored manifest, re-detect/re-train only the "
+                             "changed rows and drifted attributes, splice "
+                             "everything else from the prior run, then "
+                             "update the snapshot. Falls back to a full run "
+                             "(with a warning and an incremental.fallback "
+                             "counter) when no usable snapshot exists. "
+                             "Equivalent to DELPHI_INCREMENTAL / "
+                             "repair.incremental")
+    parser.add_argument("--snapshot-dir", dest="snapshot_dir", type=str,
+                        default="",
+                        help="snapshot directory for --incremental: holds "
+                             "the manifest (per-column content fingerprints "
+                             "+ chunked row-block fingerprints) and the "
+                             "prior run's frame/models/provenance. "
+                             "Equivalent to DELPHI_SNAPSHOT_DIR / "
+                             "repair.snapshot.dir")
     parser.add_argument("--baseline-report", dest="baseline_report", type=str,
                         default="",
                         help="prior run-report JSON to compare this run's "
@@ -222,6 +247,10 @@ def main(argv=None) -> int:
         .setDiscreteThreshold(args.discrete_threshold)
     if args.targets:
         model = model.setTargets(args.targets.split(","))
+    if args.incremental:
+        model = model.option("repair.incremental", "true")
+    if args.snapshot_dir:
+        model = model.option("repair.snapshot.dir", args.snapshot_dir)
 
     status, error = "ok", None
     drift_result = None

@@ -172,6 +172,10 @@ class RepairModel:
                 lambda v: v >= 3, "`{}` should be greater than 2")
     _opt_checkpoint_path = \
         _option("model.checkpoint_path", "", str, None, None)
+    _opt_snapshot_dir = \
+        _option("repair.snapshot.dir", "", str, None, None)
+    _opt_incremental = \
+        _option("repair.incremental", False, bool, None, None)
 
     option_keys = set([
         _opt_max_training_row_num.key,
@@ -186,6 +190,8 @@ class RepairModel:
         _opt_prob_threshold.key,
         _opt_prob_top_k.key,
         _opt_checkpoint_path.key,
+        _opt_snapshot_dir.key,
+        _opt_incremental.key,
         *ErrorModel.option_keys,
         *train_option_keys])
 
@@ -1019,8 +1025,20 @@ class RepairModel:
 
         models: Dict[str, Any] = {}
         num_class_map: Dict[str, int] = {}
+        # the incremental executor pre-seeds frozen models for attributes the
+        # drift gate cleared; those targets skip class counting and training
+        frozen: Dict[str, Any] = getattr(
+            self, "_incremental_frozen_models", None) or {}
+        for y, m in frozen.items():
+            if y in target_columns:
+                models[y] = m
+        if models:
+            _logger.info("Reusing {} frozen repair models: {}".format(
+                len(models), to_list_str(sorted(models))))
 
         for y in target_columns:
+            if y in models:
+                continue
             index = len(models) + 1
             input_columns = [c for c in train_columns if c != y]
             is_discrete = y not in continuous_columns
@@ -2150,6 +2168,9 @@ class RepairModel:
                 counter_inc("train.checkpoint_hits")
             if phase_store:
                 phase_store.save("train", models)
+        # the incremental executor snapshots the trained models after the
+        # run, so a later delta run can freeze undrifted attributes
+        self._last_models = models
         _resilience.maybe_abort()
         for _, (model, _, _) in models:
             if isinstance(model, PoorModel):
@@ -2560,13 +2581,24 @@ class RepairModel:
             int(self._get_option_value(*self._opt_max_training_row_num)),
             self.opts)
 
+        from delphi_tpu import incremental
+        run_flags = (detect_errors_only, compute_repair_candidate_prob,
+                     compute_repair_prob, compute_repair_score, repair_data,
+                     maximal_likelihood_repair)
+        self._last_incremental = None
         try:
             with profile_trace("delphi.repair.run"):
-                df, elapsed = self._run(
-                    table, input_name, continuous_columns, detect_errors_only,
-                    compute_repair_candidate_prob, compute_repair_prob,
-                    compute_repair_score, repair_data,
-                    maximal_likelihood_repair)
+                if incremental.incremental_requested(self):
+                    df, elapsed, inc_summary = incremental.run_incremental(
+                        self, table, input_name, continuous_columns,
+                        run_flags)
+                    run_info["incremental"] = inc_summary
+                    # service mode echoes the summary per request
+                    self._last_incremental = inc_summary
+                else:
+                    df, elapsed = self._run(
+                        table, input_name, continuous_columns,
+                        *run_flags)
         finally:
             if prewarm is not None:
                 prewarm.stop()

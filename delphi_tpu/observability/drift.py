@@ -23,6 +23,7 @@ Rule of thumb (the PSI folklore thresholds): < 0.1 no meaningful change,
 import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from delphi_tpu.observability.registry import counter_inc
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -31,8 +32,11 @@ _EPS = 1e-6
 
 
 def _normalize(counts: Sequence[float]) -> Optional[List[float]]:
+    """None when the vector carries no mass — empty, all-zero, or polluted
+    by NaN/inf (a tiny baseline can surface NaN bins, and ``NaN <= 0`` is
+    False, so the non-finite check must come first)."""
     total = float(sum(counts))
-    if total <= 0:
+    if not math.isfinite(total) or total <= 0:
         return None
     return [c / total for c in counts]
 
@@ -46,6 +50,7 @@ def population_stability_index(current: Sequence[float],
     p = _normalize(current)
     q = _normalize(baseline)
     if p is None or q is None:
+        counter_inc("drift.bins_empty")
         return 0.0
     psi = 0.0
     for pi, qi in zip(p, q):
@@ -61,6 +66,7 @@ def jensen_shannon_divergence(current: Sequence[float],
     p = _normalize(current)
     q = _normalize(baseline)
     if p is None or q is None:
+        counter_inc("drift.bins_empty")
         return 0.0
     js = 0.0
     for pi, qi in zip(p, q):

@@ -306,6 +306,38 @@ class ProvenanceLedger:
                 e.pop("decision", None)
                 e.pop("decision_reason", None)
 
+    # -- incremental splice ------------------------------------------------
+
+    def splice_prior_entries(self, prior_entries: Sequence[Dict[str, Any]],
+                             recomputed_reason: str = "row_replanned",
+                             reused_reason: str = "outside_delta") \
+            -> Tuple[int, int]:
+        """Splices a prior run's ledger entries under this (delta) run's.
+
+        Every cell THIS run touched keeps its fresh entry, stamped
+        ``splice: recomputed``; a prior entry whose cell this run did not
+        touch is inserted verbatim, stamped ``splice: reused``. The caller
+        pre-filters ``prior_entries`` to rows outside the delta plan — a
+        replanned row's prior cells must NOT come back, since the re-run is
+        their truth now (including "clean now, so no entry at all").
+        Returns ``(reused, recomputed)`` counts."""
+        with self._lock:
+            for e in self._cells.values():
+                e["splice"] = "recomputed"
+                e["splice_reason"] = recomputed_reason
+            reused = 0
+            for p in prior_entries or []:
+                key = (str(p.get("row_id")), str(p.get("attribute")))
+                if key in self._cells:
+                    continue
+                e = dict(p)
+                e["splice"] = "reused"
+                e["splice_reason"] = reused_reason
+                self._cells[key] = e
+                reused += 1
+            recomputed = len(self._cells) - reused
+        return reused, recomputed
+
     # -- finalize ----------------------------------------------------------
 
     def entries(self) -> List[Dict[str, Any]]:

@@ -30,7 +30,8 @@ Schema (version 3; version 1/2 reports still load, see
                         domain_size: {count, sum, min, max, mean, hist: {}},
                         repaired_values: {}, [model_cv_score]}
       },
-      "drift": null | {...}                  # v3+: --baseline-report runs
+      "drift": null | {...},                 # v3+: --baseline-report runs
+      "incremental": null | {...}            # v4+: incremental (delta) runs
     }
 
 On a multi-host cluster every rank's registry state and span tree travel
@@ -56,8 +57,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 3
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
+REPORT_SCHEMA_VERSION = 4
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -327,6 +328,7 @@ def build_run_report(recorder: Any,
         "per_process": per_process,
         "scorecards": scorecards,
         "drift": getattr(recorder, "drift", None),
+        "incremental": getattr(recorder, "incremental", None),
     }
 
 
@@ -356,10 +358,11 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1/v2 -> v3 upgrade: each version only adds keys (v2 added
-    ``per_process``, v3 added ``scorecards`` and ``drift``), so an older
-    report becomes a valid v3 one by defaulting them. Consumers can rely on
-    the v3 shape regardless of the file's age."""
+    """In-memory v1/v2/v3 -> v4 upgrade: each version only adds keys (v2
+    added ``per_process``, v3 added ``scorecards`` and ``drift``, v4 added
+    ``incremental``), so an older report becomes a valid v4 one by
+    defaulting them. Consumers can rely on the v4 shape regardless of the
+    file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
@@ -367,6 +370,7 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("per_process", None)   # v1 -> v2
     report.setdefault("scorecards", None)    # v2 -> v3
     report.setdefault("drift", None)         # v2 -> v3
+    report.setdefault("incremental", None)   # v3 -> v4
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

@@ -51,11 +51,22 @@ A ``/repair`` request body::
      "deadline_s": 30.0,                                # optional
      "options": {"model.max_training_row_num": "64"},   # optional
      "fault_plan": "domain.bucket:1:oom",               # optional (chaos)
+     "base_snapshot": "nightly",                        # optional (delta)
      "request_id": "r1"}                                # optional
 
 and the 200 response is ``{"request_id", "status": "ok", "rows",
 "frame": [...records...]}`` — ``frame`` rows are sorted by all columns so
 two servers repairing the same table respond byte-identically.
+
+``base_snapshot`` names a snapshot under the server cache dir
+(``<cache_dir>/snapshots/<id>``) and switches the request onto the
+incremental repair plane (:mod:`delphi_tpu.incremental`): the request
+diffs its table against that snapshot's manifest, repairs only the delta,
+and updates the snapshot for the next request carrying the same id. The
+first request under a fresh id runs full and populates it. The id rides
+per-request MODEL OPTIONS (not env), so concurrent requests against
+different snapshots never race. The response echoes ``base_snapshot``
+and, when the delta path ran, an ``incremental`` summary.
 """
 
 import hashlib
@@ -215,6 +226,18 @@ class RepairServer:
 
     def _ckpt_dir(self, fp: str) -> str:
         return os.path.join(self.cache_dir, "ckpt", fp[:16])
+
+    def _snapshot_dir(self, snapshot_id: str) -> str:
+        """Maps a client-supplied ``base_snapshot`` id onto the server
+        cache; ids are restricted to a filename-safe alphabet so a request
+        body can never escape ``<cache_dir>/snapshots/``."""
+        if not snapshot_id or len(snapshot_id) > 64 or \
+                not all(c.isalnum() or c in "._-" for c in snapshot_id) \
+                or snapshot_id.startswith("."):
+            raise ValueError(
+                f"bad base_snapshot id {snapshot_id!r}: expected 1-64 "
+                "chars from [A-Za-z0-9._-], not starting with '.'")
+        return os.path.join(self.cache_dir, "snapshots", snapshot_id)
 
     def start(self) -> "RepairServer":
         from delphi_tpu import observability as obs
@@ -536,6 +559,14 @@ class RepairServer:
             model.option("model.checkpoint_path", self._models_dir(fp))
             for key, value in (payload.get("options") or {}).items():
                 model.option(str(key), str(value))
+            base_snapshot = payload.get("base_snapshot")
+            if base_snapshot is not None:
+                # per-request model options, NOT env: concurrent requests
+                # against different snapshots must not race a global flag
+                snap_dir = self._snapshot_dir(str(base_snapshot))
+                os.makedirs(snap_dir, exist_ok=True)
+                model.option("repair.incremental", "true")
+                model.option("repair.snapshot.dir", snap_dir)
             prov_dir = os.environ.get("DELPHI_SERVE_PROVENANCE_DIR")
             if prov_dir:
                 os.makedirs(prov_dir, exist_ok=True)
@@ -557,6 +588,10 @@ class RepairServer:
                 "request_id": rid, "status": "ok", "rows": int(len(out)),
                 "frame": json.loads(out.to_json(orient="records")),
             }
+            if base_snapshot is not None:
+                job.response["base_snapshot"] = str(base_snapshot)
+                job.response["incremental"] = getattr(
+                    model, "_last_incremental", None)
             counter_inc("serve.completed")
         except resilience.DeadlineExceeded as e:
             counter_inc("serve.deadline_expired")

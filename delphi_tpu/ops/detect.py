@@ -38,6 +38,9 @@ CellIndex = Tuple[np.ndarray, str]  # (row indices, attribute)
 
 def detect_null_cells(table: EncodedTable, target_attrs: Sequence[str]) \
         -> List[CellIndex]:
+    # rows this detection pass actually walked — the incremental A/B's
+    # proof that a delta run detected over only the planned row subset
+    counter_inc("detect.rows_scanned", table.n_rows)
     out = []
     for name in table.column_names:
         if name in target_attrs:
@@ -899,6 +902,7 @@ def detect_constraint_violations(table: EncodedTable,
                                  target_attrs: Sequence[str]) -> List[CellIndex]:
     """For each constraint, flags every referenced target attribute of every
     violating left-tuple row (ErrorDetectorApi.scala:213-231)."""
+    counter_inc("detect.rows_scanned", table.n_rows)
     out: List[CellIndex] = []
     for preds in constraints.predicates:
         attrs = []

@@ -71,6 +71,9 @@ def compute_domain_in_error_cells(
         attrs_all = np.array([a for _, a, _ in cells], dtype=object)
         curs_all = np.array([c for _, _, c in cells], dtype=object)
 
+    # how many cells domain scoring actually worked on this run — the
+    # incremental A/B's proof that a delta run scored only the planned rows
+    counter_inc("domain.cells_scored", int(len(rows_all)))
     led = active_ledger()
     out: List[CellDomain] = []
     groups = list(_iter_attr_groups(
@@ -264,6 +267,7 @@ def compute_weak_label_mask(
         else get_active_mesh()
     table = disc.table
     led = active_ledger()
+    counter_inc("domain.cells_scored", int(len(cells[0])))
     demote = np.zeros(len(cells[0]), dtype=bool)
 
     groups = list(_iter_attr_groups(

@@ -22,8 +22,11 @@ strays). Centralizing the seam buys two things:
   untouched column keeps its buffer across every phase and table copy.
 """
 
+import hashlib
 import os
 import re
+import threading
+from collections import OrderedDict
 from typing import Any, Optional
 
 import numpy as np
@@ -37,8 +40,21 @@ _FALSY = frozenset({"0", "false", "no", "off"})
 # attribute on the (non-slots) EncodedColumn dataclass: dataclasses.replace
 # copies declared fields only, so replaced columns start cold by design.
 _DEVICE_CODES_ATTR = "_delphi_device_codes"
+# Memoized content fingerprint of a column's code vector (sha1 over the
+# raw int32 bytes). Codes are frozen once encoded — every table mutation
+# goes through dataclasses.replace with a NEW codes array — so memoizing
+# on the object is safe and makes repeat lookups O(1).
+_CODES_FP_ATTR = "_delphi_codes_fp"
 
 _PHASE_SAN = re.compile(r"[^A-Za-z0-9_.-]+")
+
+# Content-addressable device-code cache: fingerprint -> device array. Lets
+# equal-content columns hit across table REBUILDS (incremental re-encodes,
+# serve requests repairing the same table) where object identity can't.
+# Bounded FIFO so a long-lived serving process can't hoard device memory.
+_CONTENT_CACHE: "OrderedDict[str, Any]" = OrderedDict()
+_CONTENT_CACHE_LOCK = threading.Lock()
+_CONTENT_CACHE_CAP = 256
 
 
 def device_table_enabled() -> bool:
@@ -93,20 +109,69 @@ def to_device(x: Any, dtype: Any = None):
     return run_guarded("xfer.upload", lambda: jnp.asarray(arr))
 
 
+def content_cache_enabled() -> bool:
+    """True when the content-addressable layer of the device-code cache is
+    on (the default). ``DELPHI_XFER_CONTENT_CACHE`` wins over the
+    ``repair.xfer.content_cache`` session config; falsy values drop back to
+    pure object-identity caching."""
+    env = os.environ.get("DELPHI_XFER_CONTENT_CACHE")
+    if env is not None:
+        return env.strip().lower() not in _FALSY
+    from delphi_tpu.session import get_session
+
+    conf = get_session().conf.get("repair.xfer.content_cache")
+    if conf is not None:
+        return str(conf).strip().lower() not in _FALSY
+    return True
+
+
+def codes_fingerprint(col) -> str:
+    """Content fingerprint of a column's code vector (memoized on the
+    column object). Hashes the raw int32 codes only: the device buffer IS
+    those ints, so vocab spelling and column name are irrelevant to whether
+    an upload can be shared."""
+    fp = getattr(col, _CODES_FP_ATTR, None)
+    if fp is None:
+        codes = np.ascontiguousarray(col.codes)
+        fp = hashlib.sha1(codes.tobytes()).hexdigest()
+        setattr(col, _CODES_FP_ATTR, fp)
+    return fp
+
+
 def device_codes(col):
     """Device-resident int32 codes for one :class:`~delphi_tpu.table.
-    EncodedColumn` — uploaded once per column object, then served from the
-    on-object cache (``transfer.reuses`` counts the hits). With the plane
-    disabled (``DELPHI_DEVICE_TABLE=0``) every call re-uploads, which is
-    the legacy behavior the transfer ledger benchmarks against."""
+    EncodedColumn` — uploaded once per column CONTENT, then served from
+    cache (``transfer.reuses`` counts every hit). Lookup is two-level: the
+    on-object identity slot first (no hashing on the steady-state path),
+    then the content-addressable map keyed by :func:`codes_fingerprint`
+    (``transfer.content_hits`` counts those), so a rebuilt table whose
+    column bytes didn't change still reuses the device buffer. With the
+    plane disabled (``DELPHI_DEVICE_TABLE=0``) every call re-uploads, which
+    is the legacy behavior the transfer ledger benchmarks against."""
     if not device_table_enabled():
         return to_device(col.codes)
     cached = getattr(col, _DEVICE_CODES_ATTR, None)
     if cached is not None:
         counter_inc("transfer.reuses")
         return cached
+    use_content = content_cache_enabled()
+    if use_content:
+        fp = codes_fingerprint(col)
+        with _CONTENT_CACHE_LOCK:
+            arr = _CONTENT_CACHE.get(fp)
+        if arr is not None:
+            counter_inc("transfer.reuses")
+            counter_inc("transfer.content_hits")
+            setattr(col, _DEVICE_CODES_ATTR, arr)
+            return arr
     arr = to_device(col.codes)
     setattr(col, _DEVICE_CODES_ATTR, arr)
+    if use_content:
+        with _CONTENT_CACHE_LOCK:
+            _CONTENT_CACHE[fp] = arr
+            while len(_CONTENT_CACHE) > _CONTENT_CACHE_CAP:
+                _CONTENT_CACHE.popitem(last=False)
+                counter_inc("transfer.evictions")
     return arr
 
 
@@ -129,6 +194,12 @@ def evict_device_codes(cols) -> int:
                 n += 1
             except AttributeError:  # pragma: no cover - concurrent evict
                 pass
+        # the content map must drop the buffer too, or the next call would
+        # resurrect the evicted (possibly device-corrupted) array by hash
+        fp = getattr(col, _CODES_FP_ATTR, None)
+        if fp is not None:
+            with _CONTENT_CACHE_LOCK:
+                _CONTENT_CACHE.pop(fp, None)
     if n:
         counter_inc("transfer.evictions", n)
     return n

@@ -815,6 +815,16 @@ def checkpoint_dir() -> Optional[str]:
 _PHASE_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
 
 
+def fingerprint_digest(fingerprint: Dict[str, Any]) -> str:
+    """Stable hex digest of a fingerprint dict: canonical JSON (sorted
+    keys, non-JSON leaves stringified) through sha1. The identity the
+    incremental plane's snapshot manifests and the checkpoint stores share
+    — equal fingerprints digest equal across processes and hosts."""
+    import hashlib
+    canonical = json.dumps(fingerprint, sort_keys=True, default=str)
+    return hashlib.sha1(canonical.encode("utf-8", "replace")).hexdigest()
+
+
 class PhaseCheckpointStore:
     """Fingerprinted per-phase pickles under one directory. Same trust
     boundary as the model checkpoint (model.py): checkpoints are plain
@@ -827,6 +837,9 @@ class PhaseCheckpointStore:
     def __init__(self, directory: str, fingerprint: Dict[str, Any]) -> None:
         self.directory = directory
         self.fingerprint = fingerprint
+        # compact identity for logs and for cross-referencing a checkpoint
+        # with the snapshot manifest that produced it
+        self.digest = fingerprint_digest(fingerprint)
 
     def _path(self, phase: str) -> str:
         return os.path.join(self.directory,
@@ -856,7 +869,8 @@ class PhaseCheckpointStore:
             counter_inc("resilience.checkpoint.stale")
             return None
         counter_inc("resilience.checkpoint.hits")
-        _logger.info(f"Resuming phase '{phase}' from checkpoint {path}")
+        _logger.info(f"Resuming phase '{phase}' from checkpoint {path} "
+                     f"(fingerprint {self.digest[:12]})")
         return payload["payload"]
 
     def save(self, phase: str, payload: Any) -> None:

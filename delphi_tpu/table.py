@@ -278,6 +278,24 @@ class EncodedTable:
                 data[c.name] = c.decode(rows)
         return pd.DataFrame(data)
 
+    def take_rows(self, positions: np.ndarray) -> "EncodedTable":
+        """Returns a positional row-subset copy (rows in the given order).
+
+        Vocabularies carry over unchanged — a subset column may hold unused
+        vocab entries, which downstream consumers tolerate (class counts and
+        domains derive from the codes actually present). The backbone of the
+        incremental plane's "re-run only the planned rows" path."""
+        positions = np.asarray(positions, dtype=np.int64)
+        new_columns = [
+            replace(
+                c,
+                codes=np.ascontiguousarray(c.codes[positions]),
+                numeric=np.ascontiguousarray(c.numeric[positions])
+                if c.numeric is not None else None)
+            for c in self.columns]
+        return replace(self, row_id_values=self.row_id_values[positions],
+                       columns=new_columns)
+
     def with_updates(self, cells: Sequence[Tuple[int, str, Any]]) -> "EncodedTable":
         """Returns a copy with (row_index, attribute, value) cells updated —
         the encoded-tensor equivalent of applying rule repairs with

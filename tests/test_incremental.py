@@ -1,0 +1,317 @@
+"""Incremental repair plane (delphi_tpu/incremental/): manifest
+fingerprint stability, delta-plan classification and fallbacks,
+constraint dirty-set expansion, the empty-bin drift regression, the
+content-addressable device-code cache, the one-time fallback warning,
+and the tier-1 full-vs-delta A/B (bench.incremental_smoke — spliced
+frame bit-identical to from-scratch on a clean-append workload)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bench
+import delphi_tpu.observability as obs
+from delphi_tpu.constraints import parse
+from delphi_tpu.incremental import executor, manifest as mf
+from delphi_tpu.incremental.depgraph import (
+    constraint_eq_keys, expand_dirty_rows,
+)
+from delphi_tpu.incremental.planner import plan_delta
+from delphi_tpu.observability.drift import (
+    jensen_shannon_divergence, population_stability_index,
+)
+from delphi_tpu.table import encode_table
+
+
+@pytest.fixture(autouse=True)
+def _clean_incremental_state():
+    saved = {v: os.environ.get(v) for v in
+             ("DELPHI_INCREMENTAL", "DELPHI_SNAPSHOT_DIR",
+              "DELPHI_SNAPSHOT_BLOCK_ROWS", "DELPHI_INCREMENTAL_DRIFT_MAX",
+              "DELPHI_XFER_CONTENT_CACHE", "DELPHI_PROVENANCE_PATH")}
+    executor._warned.clear()
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+    executor._warned.clear()
+
+
+def _frame(n: int = 12) -> pd.DataFrame:
+    return pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": [f"g{i % 3}" for i in range(n)],
+        "c1": [None if i % 5 == 0 else f"v{i % 3}" for i in range(n)],
+        "c2": [str((i * 7) % 4) for i in range(n)],
+    })
+
+
+# -- manifest stability -------------------------------------------------------
+
+def test_manifest_fingerprints_invariant_under_column_reorder():
+    df = _frame()
+    a = mf.build_manifest(encode_table(df, "tid"), block=4)
+    b = mf.build_manifest(
+        encode_table(df[["tid", "c2", "c0", "c1"]], "tid"), block=4)
+    assert a["row_id"]["value_sha1"] == b["row_id"]["value_sha1"]
+    for name in ("c0", "c1", "c2"):
+        assert a["columns"][name]["value_sha1"] \
+            == b["columns"][name]["value_sha1"]
+        assert a["columns"][name]["block_sha1"] \
+            == b["columns"][name]["block_sha1"]
+    assert a["snapshot_id"] == b["snapshot_id"]
+
+
+def test_manifest_whole_fingerprint_invariant_under_block_size():
+    table = encode_table(_frame(), "tid")
+    small = mf.build_manifest(table, block=3)
+    large = mf.build_manifest(table, block=8)
+    for name in ("c0", "c1", "c2"):
+        assert small["columns"][name]["value_sha1"] \
+            == large["columns"][name]["value_sha1"]
+        assert small["columns"][name]["block_sha1"] \
+            != large["columns"][name]["block_sha1"]
+
+
+def test_plan_diffs_with_manifest_block_size_not_current_setting():
+    """A snapshot written under block_rows=3 must diff correctly after the
+    knob changes: plan_delta recomputes block fingerprints with the
+    MANIFEST's chunk size, so a chunk-boundary shift can't smear clean
+    rows into the dirty set."""
+    df = _frame()
+    manifest = mf.build_manifest(encode_table(df, "tid"), block=3)
+    os.environ["DELPHI_SNAPSHOT_BLOCK_ROWS"] = "5"
+    edited = df.copy()
+    edited.loc[7, "c2"] = "edited"
+    plan = plan_delta(encode_table(edited, "tid"), manifest)
+    assert plan.usable
+    assert plan.dirty_columns == ["c2"]
+    # row 7 lives in block 2 of 3-row blocks: exactly rows 6..8 replan
+    assert plan.updated_rows.tolist() == [6, 7, 8]
+
+
+def test_merge_manifests_concatenates_shards():
+    df = _frame(8)
+    whole = mf.build_manifest(encode_table(df, "tid"), block=4)
+    lo = mf.build_manifest(encode_table(df.iloc[:4], "tid"), block=4)
+    hi = mf.build_manifest(
+        encode_table(df.iloc[4:].reset_index(drop=True), "tid"), block=4)
+    merged = mf.merge_manifests(lo, hi)
+    assert merged["merged"] is True
+    assert merged["n_rows"] == whole["n_rows"]
+    for name in ("c0", "c1", "c2"):
+        # block fingerprints hash only their own rows, so aligned shards
+        # concatenate to exactly the whole-table block list
+        assert merged["columns"][name]["block_sha1"] \
+            == whole["columns"][name]["block_sha1"]
+        mh, wh = (m["columns"][name]["histogram"] for m in (merged, whole))
+        assert mh["values"] == wh["values"]
+        assert mh["null"] == wh["null"]
+    assert merged["row_id"]["block_sha1"] == whole["row_id"]["block_sha1"]
+    with pytest.raises(ValueError):
+        mf.merge_manifests(lo, mf.build_manifest(
+            encode_table(df.iloc[4:].reset_index(drop=True), "tid"),
+            block=2))
+
+
+# -- delta planner ------------------------------------------------------------
+
+def test_plan_fallback_reasons():
+    df = _frame()
+    table = encode_table(df, "tid")
+    manifest = mf.build_manifest(table, options_digest="d0", block=4)
+
+    assert plan_delta(table, None).fallback_reason == "no_manifest"
+    assert plan_delta(table, manifest, options_digest="d1") \
+        .fallback_reason == "options_changed"
+
+    renamed = encode_table(df.rename(columns={"c2": "c9"}), "tid")
+    assert plan_delta(renamed, manifest, options_digest="d0") \
+        .fallback_reason == "schema_changed"
+
+    shrunk = encode_table(df.iloc[:6], "tid")
+    assert plan_delta(shrunk, manifest, options_digest="d0") \
+        .fallback_reason == "rows_removed"
+
+    rekeyed = df.copy()
+    rekeyed.loc[3, "tid"] = "999"
+    assert plan_delta(encode_table(rekeyed, "tid"), manifest,
+                      options_digest="d0") \
+        .fallback_reason == "row_ids_changed"
+
+
+def test_plan_clean_append_classification():
+    df = _frame()
+    manifest = mf.build_manifest(encode_table(df, "tid"), block=4)
+    appended = pd.concat(
+        [df, _frame(16).iloc[12:]], ignore_index=True)
+    plan = plan_delta(encode_table(appended, "tid"), manifest)
+    assert plan.usable
+    assert plan.dirty_columns == []
+    assert plan.rows_unchanged == len(df)
+    assert plan.updated_rows.tolist() == []
+    assert plan.appended_rows.tolist() == [12, 13, 14, 15]
+    # appended rows keep the base distribution, so the drift gate clears
+    # columns for model reuse
+    assert len(plan.reusable_attrs) >= 1
+    assert all(psi < 0.1 for psi in plan.drift_psi.values())
+
+
+# -- constraint dirty-set expansion -------------------------------------------
+
+def test_expand_multi_attribute_fd_pulls_full_key_groups_only():
+    """Two-EQ-key constraint (the multi-attribute FD shape): a dirty row
+    pulls rows agreeing on BOTH key attributes; rows sharing only one key
+    attr, and rows with NULL in a key attr, stay out of the plan."""
+    df = pd.DataFrame({
+        "tid": list("012345"),
+        "a": ["x", "x", "x", "y", None, "z"],
+        "b": ["p", "p", "q", "p", "p", "z"],
+        "c": ["1", "2", "3", "4", "5", "6"],
+    })
+    table = encode_table(df, "tid")
+    preds = parse("t1&t2&EQ(t1.a,t2.a)&EQ(t1.b,t2.b)&IQ(t1.c,t2.c)")
+    assert constraint_eq_keys(preds) == ["a", "b"]
+    planned = expand_dirty_rows(table, [preds],
+                                np.array([0], dtype=np.int64))
+    assert planned.tolist() == [0, 1]
+
+
+def test_expand_without_eq_key_is_conservative():
+    table = encode_table(_frame(6), "tid")
+    no_key = parse("t1&t2&IQ(t1.c0,t2.c0)&IQ(t1.c1,t2.c1)")
+    assert constraint_eq_keys(no_key) == []
+    planned = expand_dirty_rows(table, [no_key],
+                                np.array([2], dtype=np.int64))
+    assert planned.tolist() == list(range(6))
+
+    asym = parse("t1&t2&EQ(t1.c0,t2.c1)&IQ(t1.c2,t2.c2)")
+    assert constraint_eq_keys(asym) == []
+
+
+def test_expand_with_no_dirty_rows_is_empty():
+    table = encode_table(_frame(6), "tid")
+    preds = parse("t1&t2&EQ(t1.c0,t2.c0)&IQ(t1.c2,t2.c2)")
+    assert expand_dirty_rows(table, [preds],
+                             np.empty(0, dtype=np.int64)).tolist() == []
+
+
+# -- drift empty-bin regression -----------------------------------------------
+
+def test_drift_empty_bins_return_zero_and_count():
+    """A 2-row baseline can surface empty or NaN histogram vectors; PSI/JS
+    must return 0.0 (not NaN/inf) and bump drift.bins_empty."""
+    rec = obs.start_recording("test.drift.empty_bins")
+    try:
+        assert population_stability_index([], []) == 0.0
+        assert population_stability_index([0.0, 0.0], [1.0, 2.0]) == 0.0
+        assert population_stability_index([float("nan")], [1.0]) == 0.0
+        assert jensen_shannon_divergence([], [1.0]) == 0.0
+        assert jensen_shannon_divergence([3.0], [0.0]) == 0.0
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("drift.bins_empty", 0) == 5
+
+
+def test_drift_two_row_baseline_regression():
+    """The literal regression: scorecards built from a 2-row run have one
+    confident bin at most; comparing against an all-empty baseline must
+    stay finite and gate nothing."""
+    from delphi_tpu.observability.drift import compare_scorecards
+    current = {"c1": {"confidence": {"bins": [0.0] * 10},
+                      "repaired_values": {}, "repair_rate": 0.0,
+                      "cells_flagged": 0}}
+    baseline = {"c1": {"confidence": {"bins": [2.0] + [0.0] * 9},
+                       "repaired_values": {"v": 2}, "repair_rate": 1.0,
+                       "cells_flagged": 2}}
+    rec = obs.start_recording("test.drift.two_row")
+    try:
+        result = compare_scorecards(current, baseline)
+    finally:
+        obs.stop_recording(rec)
+    assert result["per_attribute"]["c1"]["confidence_psi"] == 0.0
+    assert result["per_attribute"]["c1"]["repair_value_js"] == 0.0
+    assert np.isfinite(result["max_divergence"])
+
+
+# -- content-addressable device-code cache ------------------------------------
+
+def test_xfer_content_cache_hits_across_table_rebuild(monkeypatch):
+    from delphi_tpu.ops import xfer
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    monkeypatch.setenv("DELPHI_XFER_CONTENT_CACHE", "1")
+    df = _frame()
+    col1 = encode_table(df, "tid").column("c0")
+    col2 = encode_table(df.copy(), "tid").column("c0")
+    assert col1 is not col2
+    fp = xfer.codes_fingerprint(col1)
+    assert fp == xfer.codes_fingerprint(col2)
+    with xfer._CONTENT_CACHE_LOCK:
+        xfer._CONTENT_CACHE.pop(fp, None)
+    rec = obs.start_recording("test.xfer.content")
+    try:
+        a = xfer.device_codes(col1)
+        b = xfer.device_codes(col2)  # rebuilt table, same bytes: hit
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert b is a
+    assert counters.get("transfer.content_hits", 0) == 1
+
+    # eviction must drop the content-map entry too, or a corrupted device
+    # buffer would resurrect by hash
+    assert xfer.evict_device_codes([col1, col2]) == 2
+    assert xfer.cached_device_codes(col1) is None
+    with xfer._CONTENT_CACHE_LOCK:
+        assert fp not in xfer._CONTENT_CACHE
+
+
+def test_xfer_content_cache_disabled_no_cross_object_hit(monkeypatch):
+    from delphi_tpu.ops import xfer
+    monkeypatch.setenv("DELPHI_DEVICE_TABLE", "1")
+    monkeypatch.setenv("DELPHI_XFER_CONTENT_CACHE", "0")
+    df = _frame()
+    col1 = encode_table(df, "tid").column("c1")
+    col2 = encode_table(df.copy(), "tid").column("c1")
+    rec = obs.start_recording("test.xfer.content_off")
+    try:
+        xfer.device_codes(col1)
+        xfer.device_codes(col2)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("transfer.content_hits", 0) == 0
+    xfer.evict_device_codes([col1, col2])
+
+
+# -- fallback warning ---------------------------------------------------------
+
+def test_fallback_warns_once_but_counts_every_time(monkeypatch):
+    warnings = []
+    monkeypatch.setattr(executor._logger, "warning",
+                        lambda msg, *a, **k: warnings.append(msg))
+    rec = obs.start_recording("test.incremental.fallback")
+    try:
+        executor._warn_once("/tmp/snap_x", "no_manifest")
+        executor._warn_once("/tmp/snap_x", "no_manifest")
+        executor._warn_once("/tmp/snap_x", "options_changed")
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("incremental.fallback", 0) == 3
+    assert len(warnings) == 2  # one per (directory, reason)
+
+
+# -- full-vs-delta A/B (tier-1) -----------------------------------------------
+
+def test_incremental_smoke_ab_bit_identical(session):
+    """bench.incremental_smoke: populate -> delta -> from-scratch; the
+    spliced delta frame must be bit-identical to the from-scratch run on
+    the clean-append workload, with detection/scoring strictly confined
+    to the planned subset and the incremental.* counters emitted."""
+    assert bench.incremental_smoke() == 0

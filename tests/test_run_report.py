@@ -267,6 +267,19 @@ def test_v2_report_upgrades_on_load(tmp_path):
     assert loaded["drift"] is None
 
 
+def test_v3_report_upgrades_on_load(tmp_path):
+    v3 = {"schema_version": 3, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"},
+          "per_process": None, "scorecards": None, "drift": None}
+    path = tmp_path / "v3.json"
+    path.write_text(json.dumps(v3))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert loaded["schema_version_loaded_from"] == 3
+    assert loaded["incremental"] is None
+
+
 def test_write_run_report_is_atomic(tmp_path):
     """A failed serialization must not clobber an existing report: the write
     goes to a temp file that is os.replace'd only on success."""
