@@ -333,7 +333,10 @@ def smoke() -> int:
     rc = chaos_smoke(df)
     if rc:
         return rc
-    return incremental_smoke()
+    rc = incremental_smoke()
+    if rc:
+        return rc
+    return escalate_smoke()
 
 
 def _smoke_frame():
@@ -693,6 +696,174 @@ def incremental() -> int:
     return incremental_smoke(
         n=int(os.environ.get("DELPHI_BENCH_INCR_ROWS", "8192")),
         min_speedup=float(os.environ.get("DELPHI_BENCH_INCR_SPEEDUP", "2.0")))
+
+
+def _escalate_frames(n: int = 96):
+    """Escalation A/B fixture. `c1` is fully determined by `c0`, so the
+    models repair its nulls confidently and those cells must NOT route.
+    `c2` is a structured `NNN-NN` code whose first factor (`i % 7`) appears
+    in no other column — the models cannot be confident about it, so its
+    error cells land under the confidence threshold and route. Corruptions:
+    broken separators in `c2` (regex-detected, exactly what the induced
+    pattern tier salvages) plus nulls in `c1` and `c2`. Returns
+    `(dirty, truth)` with `truth` mapping `(tid, attribute)` -> clean
+    value for every corrupted cell."""
+    import pandas as pd
+
+    clean = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": [f"g{i % 8}" for i in range(n)],
+        "c1": [f"v{(i % 8) % 4}" for i in range(n)],
+        "c2": [f"{100 + i % 7}-{10 + i % 8}" for i in range(n)],
+    })
+    dirty = clean.copy()
+    truth = {}
+    for i in range(5, n, 13):   # separator breaks: pattern-tier repairable
+        dirty.loc[i, "c2"] = clean.loc[i, "c2"].replace("-", "x")
+        truth[(str(i), "c2")] = clean.loc[i, "c2"]
+    for i in range(3, n, 17):   # nulls the models repair confidently
+        dirty.loc[i, "c1"] = None
+        truth[(str(i), "c1")] = clean.loc[i, "c1"]
+    for i in range(7, n, 23):   # nulls only the joint tier can reason about
+        dirty.loc[i, "c2"] = None
+        truth[(str(i), "c2")] = clean.loc[i, "c2"]
+    return dirty, truth
+
+
+def _escalate_f1(frame, truth) -> float:
+    """Cell-level F1 of a repair-candidates frame against the fixture's
+    ground truth (the flights metric, restricted to the injected cells)."""
+    by_cell = {(str(r), str(a)): v for r, a, v in
+               zip(frame["tid"], frame["attribute"], frame["repaired"])}
+    correct = sum(1 for k, v in by_cell.items() if truth.get(k) == v)
+    p = correct / len(by_cell) if by_cell else 0.0
+    r = correct / len(truth) if truth else 0.0
+    return 2 * p * r / (p + r) if p + r else 0.0
+
+
+#: escalation env knobs neutralized (and restored) around the smoke A/B so
+#: an operator's environment cannot flip the baseline runs
+_ESCALATE_ENV = ("DELPHI_ESCALATE", "DELPHI_ESCALATE_CONF",
+                 "DELPHI_ESCALATE_BUDGET", "DELPHI_ESCALATE_ITERS",
+                 "DELPHI_ESCALATE_ADAPTER", "DELPHI_ESCALATE_ADAPTER_CALLS")
+
+
+def escalate_smoke(n: int = 96) -> int:
+    """Escalation tier A/B: the same dirty frame repaired three times —
+    baseline (no option), escalation explicitly off, escalation on. Off
+    must be BIT-IDENTICAL to baseline; on must route low-confidence cells,
+    apply at least one induced-pattern repair, launch the joint-inference
+    kernel as a batched device call (visible in the transfer ledger's
+    `escalation` phase and the `escalation.*` counters), change ONLY cells
+    inside the routed set, not regress F1 against the fixture's ground
+    truth, and keep the adapter tier hard off. Prints one JSON line; exit
+    code 1 on failure."""
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, RegExErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.session import get_session
+
+    dirty, truth = _escalate_frames(n)
+    saved_env = {k: os.environ.pop(k, None) for k in _ESCALATE_ENV}
+
+    def one_run(tag: str, escalate) -> dict:
+        _heartbeat(f"escalate smoke {tag} run")
+        name = f"esc_smoke_{tag}"
+        get_session().register(name, dirty.copy())
+        rec = obs.start_recording(f"bench.escalate.{tag}")
+        try:
+            model = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([
+                    NullErrorDetector(),
+                    RegExErrorDetector("c2", "^[0-9]{3}-[0-9]{2}$"),
+                ])
+            if escalate is not None:
+                model = model.option("repair.escalate", escalate)
+            out = model.run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+        counters = rec.registry.snapshot()["counters"]
+        frame = out.sort_values(list(out.columns)).reset_index(drop=True)
+        return {
+            "f1": round(_escalate_f1(frame, truth), 4),
+            "escalation": {k: int(v) for k, v in counters.items()
+                           if k.startswith("escalation.")},
+            "xfer_escalation_calls": int(
+                counters.get("transfer.phase.escalation.calls", 0)),
+            "summary": getattr(rec, "escalation", None),
+            "frame": frame,
+        }
+
+    try:
+        base = one_run("base", None)
+        off = one_run("off", "false")
+        on = one_run("on", "true")
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(base["frame"], off["frame"])
+    except AssertionError:
+        frames_equal = False
+
+    def cells(frame):
+        return {(str(r), str(a)): v for r, a, v in
+                zip(frame["tid"], frame["attribute"], frame["repaired"])}
+
+    base_cells, on_cells = cells(base["frame"]), cells(on["frame"])
+    changed = {k for k in set(base_cells) | set(on_cells)
+               if base_cells.get(k) != on_cells.get(k)}
+    summary = on["summary"] or {}
+    routed = {(str(r), str(a)) for r, a in summary.get("routed_cells", [])}
+    tiers = summary.get("tiers") or {}
+    esc = on["escalation"]
+    for r in (base, off, on):
+        del r["frame"]
+
+    ok = frames_equal \
+        and summary.get("requested") is True \
+        and summary.get("routed", 0) > 0 \
+        and summary.get("escalated", 0) > 0 \
+        and bool(changed) and changed <= routed \
+        and on["f1"] >= off["f1"] \
+        and (tiers.get("pattern") or {}).get("repairs", 0) >= 1 \
+        and esc.get("escalation.joint.launches", 0) >= 1 \
+        and on["xfer_escalation_calls"] > 0 \
+        and (tiers.get("adapter") or {}).get("allowed") is False \
+        and esc.get("escalation.adapter.calls", 0) == 0
+    print(json.dumps({
+        "metric": "escalate_smoke",
+        "value": round(on["f1"] - off["f1"], 4),
+        "unit": "f1 delta (on-off)", "vs_baseline": None, "ok": ok,
+        "rows": n, "frames_equal_off": frames_equal,
+        "changed_cells": sorted(list(c) for c in changed),
+        "routed": len(routed), "base": base, "off": off, "on": on,
+    }), flush=True)
+    if not ok:
+        print("escalate smoke FAILED: escalation off must be bit-identical "
+              "to baseline, and on must repair only routed cells without "
+              f"regressing F1 (frames_equal={frames_equal}, "
+              f"changed={sorted(changed)}, routed={len(routed)}, "
+              f"on={on}, off={off})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def escalate() -> int:
+    """Standalone `bench.py --escalate` entry: CPU backend escalation tier
+    A/B (see escalate_smoke)."""
+    _force_cpu_backend()
+    return escalate_smoke(n=int(os.environ.get("DELPHI_BENCH_ESC_ROWS",
+                                               "96")))
 
 
 # The scoped service-mode plan: one transient upload fault (exercises the
@@ -1088,6 +1259,14 @@ def main() -> None:
                              "scratch, asserting bit-identical frames, "
                              "subset detection/domain work, and >=2x "
                              "wall-clock speedup; exits 1 on failure")
+    parser.add_argument("--escalate", dest="escalate", action="store_true",
+                        help="escalation tier A/B on the CPU backend: the "
+                             "same dirty frame with escalation off vs on, "
+                             "asserting off is bit-identical to baseline, "
+                             "on repairs only routed low-confidence cells "
+                             "via pattern/joint tiers without regressing "
+                             "F1, and the adapter tier stays hard off; "
+                             "exits 1 on failure")
     parser.add_argument("--serve-chaos", dest="serve_chaos",
                         action="store_true",
                         help="service-mode chaos A/B on the CPU backend: "
@@ -1108,6 +1287,9 @@ def main() -> None:
 
     if args.incremental:
         sys.exit(incremental())
+
+    if args.escalate:
+        sys.exit(escalate())
 
     if args.serve_chaos:
         sys.exit(serve_chaos())

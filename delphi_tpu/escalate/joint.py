@@ -1,0 +1,178 @@
+"""Tier B: HoloClean-style joint inference over correlated attributes.
+
+Builds a factor graph for the routed cells out of statistics the pipeline
+already computes (:func:`delphi_tpu.ops.freq.compute_freq_stats` over the
+MASKED table, so every count comes from cells believed clean):
+
+* **unary potentials** — Laplace-smoothed log prior of each candidate value
+  plus one log-conditional term per OBSERVED same-row context attribute
+  (``log P(a = v | c = u)`` from the pair count matrices);
+* **pairwise potentials** — the same conditionals between two UNKNOWN cells
+  that share a row, which is what single-cell scoring cannot do: two
+  routed cells in one row constrain each other through the iteration.
+
+Cells bucket by the power-of-two pad of their candidate-domain size, each
+bucket pads ``(n, K, V)`` and runs as ONE jit-compiled device launch of
+:func:`delphi_tpu.ops.joint.joint_beliefs` (upload seam + ``run_guarded``
+-> transfer ledger + resilience plane). Cross-bucket neighbor coupling is
+dropped — those neighbors still contribute as observed context would not,
+but their pair statistics do via the unary prior; the alternative (one
+bucket padded to the global max V) wastes quadratically more FLOPs on the
+``[V, V]`` potentials.
+
+Proposals are accepted when the converged belief clears both the routing
+threshold and the cell's original confidence — joint inference must be
+MORE sure than the model it is overriding.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from delphi_tpu.escalate.router import RoutedCell
+from delphi_tpu.observability import counter_inc
+from delphi_tpu.ops.freq import compute_freq_stats
+from delphi_tpu.ops.joint import NEG_INF, joint_beliefs
+
+#: Laplace smoothing for every count-derived log potential
+ALPHA = 0.5
+#: largest candidate domain joint inference will model (the pairwise
+#: potentials are [V, V] per neighbor edge — quadratic memory)
+MAX_DOMAIN = 64
+#: observed context attributes folded into each cell's unary potential
+CTX_CAP = 4
+#: same-row unknown neighbors kept per cell (column order, deterministic)
+NBR_CAP = 4
+
+
+class JointProposal:
+    __slots__ = ("cell", "value", "belief")
+
+    def __init__(self, cell: RoutedCell, value: str, belief: float) -> None:
+        self.cell = cell
+        self.value = value
+        self.belief = belief
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    v = max(int(n), floor)
+    return 1 << (v - 1).bit_length()
+
+
+def _log_cond(pair_uv: np.ndarray, single_u: np.ndarray,
+              v_size: int) -> np.ndarray:
+    """log P(v | u) with Laplace smoothing; ``pair_uv`` is [U+1, V+1] raw
+    counts (slot 0 = NULL), returns [U, V] over the non-NULL values."""
+    num = pair_uv[1:, 1:].astype(np.float64) + ALPHA
+    den = single_u[1:].astype(np.float64)[:, None] + ALPHA * v_size
+    return np.log(num / den)
+
+
+def run_joint_tier(masked: Any, cells: List[RoutedCell],
+                   conf_threshold: float, iters: int) -> List[JointProposal]:
+    """Joint inference over ``cells`` against the ``masked`` encoded table
+    (error cells already nulled). Returns accepted proposals; counters
+    ``escalation.joint.*`` record launches/cells/proposals."""
+    if not cells:
+        return []
+    name_to_col = {c.name: c for c in masked.columns}
+    # participating attributes: discrete enough for the [V, V] potentials
+    attrs = [c.name for c in masked.columns
+             if c.name in {x.attribute for x in cells}
+             and 1 <= c.domain_size <= MAX_DOMAIN]
+    attr_set = set(attrs)
+    todo = [c for c in cells if c.attribute in attr_set]
+    if not todo:
+        return []
+    # context attributes: reasonably discrete columns (including the
+    # targets themselves — a routed cell is context for OTHER attributes'
+    # cells only when observed, which the per-cell masking below enforces);
+    # capped so the all-pairs stat pass stays bounded on wide tables
+    ctx_attrs = [c.name for c in masked.columns
+                 if 1 <= c.domain_size <= MAX_DOMAIN]
+    needed = list(dict.fromkeys(attrs + ctx_attrs))[:16]
+    ctx_attrs = [a for a in ctx_attrs if a in set(needed)]
+    pairs = [(a, b) for i, a in enumerate(needed) for b in needed[i + 1:]]
+    stats = compute_freq_stats(masked, needed, pairs)
+
+    routed_keys = {(c.row_pos, c.attribute) for c in todo}
+    by_row: Dict[int, List[int]] = {}
+    for i, c in enumerate(todo):
+        by_row.setdefault(c.row_pos, []).append(i)
+
+    # bucket by padded domain size so one compiled executable serves every
+    # attribute whose vocabulary lands in the same power-of-two band
+    buckets: Dict[int, List[int]] = {}
+    for i, c in enumerate(todo):
+        buckets.setdefault(
+            _pow2(name_to_col[c.attribute].domain_size), []).append(i)
+
+    proposals: List[JointProposal] = []
+    for v_pad in sorted(buckets):
+        members = buckets[v_pad]
+        n_pad = _pow2(len(members))
+        unary = np.full((n_pad, v_pad), NEG_INF, dtype=np.float32)
+        unary[:, 0] = 0.0  # padded rows: a defined softmax, discarded below
+        nbr_idx = np.full((n_pad, NBR_CAP), -1, dtype=np.int32)
+        nbr_pot = np.zeros((n_pad, NBR_CAP, v_pad, v_pad), dtype=np.float32)
+        slot_of = {idx: s for s, idx in enumerate(members)}
+
+        for s, idx in enumerate(members):
+            cell = todo[idx]
+            a = cell.attribute
+            col = name_to_col[a]
+            va = col.domain_size
+            single_a = stats.single(a, filtered=False)
+            n_obs = float(single_a[1:].sum())
+            u = np.log((single_a[1:].astype(np.float64) + ALPHA)
+                       / (n_obs + ALPHA * va))
+            # observed context: same-row cells that are NOT routed unknowns
+            n_ctx = 0
+            for c_attr in ctx_attrs:
+                if c_attr == a or n_ctx >= CTX_CAP:
+                    continue
+                if (cell.row_pos, c_attr) in routed_keys:
+                    continue
+                code = int(name_to_col[c_attr].codes[cell.row_pos])
+                if code < 0 or not stats.has_pair(c_attr, a):
+                    continue
+                cond = _log_cond(stats.pair(c_attr, a, filtered=False),
+                                 stats.single(c_attr, filtered=False), va)
+                u = u + cond[code]
+                n_ctx += 1
+            unary[s, :va] = u.astype(np.float32)
+            unary[s, va:] = NEG_INF
+            # unknown neighbors: other routed cells of this row, same bucket
+            k = 0
+            for j in by_row.get(cell.row_pos, []):
+                if j == idx or k >= NBR_CAP:
+                    continue
+                other = todo[j]
+                if other.attribute == a or j not in slot_of:
+                    continue
+                b_attr = other.attribute
+                if not stats.has_pair(b_attr, a):
+                    continue
+                vb = name_to_col[b_attr].domain_size
+                pot = _log_cond(stats.pair(b_attr, a, filtered=False),
+                                stats.single(b_attr, filtered=False), va)
+                nbr_idx[s, k] = slot_of[j]
+                nbr_pot[s, k, :vb, :va] = pot.astype(np.float32)
+                k += 1
+
+        beliefs = joint_beliefs(unary, nbr_idx, nbr_pot, iters)
+        counter_inc("escalation.joint.launches")
+        counter_inc("escalation.joint.cells", len(members))
+
+        for s, idx in enumerate(members):
+            cell = todo[idx]
+            col = name_to_col[cell.attribute]
+            va = col.domain_size
+            b = beliefs[s, :va]
+            v = int(np.argmax(b))
+            value = str(col.vocab[v])
+            accept_at = max(conf_threshold, cell.confidence or 0.0)
+            if value != cell.current_value and float(b[v]) >= accept_at:
+                proposals.append(JointProposal(cell, value, float(b[v])))
+    counter_inc("escalation.joint.proposals", len(proposals))
+    return proposals

@@ -153,6 +153,25 @@ def main(argv=None) -> int:
                              "prior run's frame/models/provenance. "
                              "Equivalent to DELPHI_SNAPSHOT_DIR / "
                              "repair.snapshot.dir")
+    parser.add_argument("--escalate", dest="escalate", action="store_true",
+                        help="confidence-routed escalation pass: cells the "
+                             "statistical models are unsure about (posterior "
+                             "confidence below --escalate-conf, DC-minimizer "
+                             "keep-alls) are re-repaired through induced "
+                             "pattern salvage and joint inference over "
+                             "correlated attributes, under a strict per-run "
+                             "cell budget (see docs/source/escalation.rst). "
+                             "Equivalent to DELPHI_ESCALATE / repair.escalate")
+    parser.add_argument("--escalate-conf", dest="escalate_conf", type=float,
+                        default=None,
+                        help="confidence threshold below which cells route "
+                             "to escalation (default 0.5). Equivalent to "
+                             "DELPHI_ESCALATE_CONF / repair.escalate.conf")
+    parser.add_argument("--escalate-budget", dest="escalate_budget", type=int,
+                        default=None,
+                        help="max cell x tier escalation attempts per run "
+                             "(default 256). Equivalent to "
+                             "DELPHI_ESCALATE_BUDGET / repair.escalate.budget")
     parser.add_argument("--baseline-report", dest="baseline_report", type=str,
                         default="",
                         help="prior run-report JSON to compare this run's "
@@ -251,6 +270,13 @@ def main(argv=None) -> int:
         model = model.option("repair.incremental", "true")
     if args.snapshot_dir:
         model = model.option("repair.snapshot.dir", args.snapshot_dir)
+    if args.escalate:
+        model = model.option("repair.escalate", "true")
+    if args.escalate_conf is not None:
+        model = model.option("repair.escalate.conf", str(args.escalate_conf))
+    if args.escalate_budget is not None:
+        model = model.option("repair.escalate.budget",
+                             str(args.escalate_budget))
 
     status, error = "ok", None
     drift_result = None

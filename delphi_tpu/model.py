@@ -176,6 +176,19 @@ class RepairModel:
         _option("repair.snapshot.dir", "", str, None, None)
     _opt_incremental = \
         _option("repair.incremental", False, bool, None, None)
+    _opt_escalate = \
+        _option("repair.escalate", False, bool, None, None)
+    _opt_escalate_conf = \
+        _option("repair.escalate.conf", 0.5, float,
+                lambda v: 0.0 <= v <= 1.0, "`{}` should be in [0.0, 1.0]")
+    _opt_escalate_budget = \
+        _option("repair.escalate.budget", 256, int,
+                lambda v: v >= 0, "`{}` should be greater than or equal to 0")
+    _opt_escalate_iters = \
+        _option("repair.escalate.iters", 8, int,
+                lambda v: v >= 1, "`{}` should be greater than 0")
+    _opt_escalate_adapter = \
+        _option("repair.escalate.adapter", "", str, None, None)
 
     option_keys = set([
         _opt_max_training_row_num.key,
@@ -192,6 +205,11 @@ class RepairModel:
         _opt_checkpoint_path.key,
         _opt_snapshot_dir.key,
         _opt_incremental.key,
+        _opt_escalate.key,
+        _opt_escalate_conf.key,
+        _opt_escalate_budget.key,
+        _opt_escalate_iters.key,
+        _opt_escalate_adapter.key,
         *ErrorModel.option_keys,
         *train_option_keys])
 
@@ -599,7 +617,7 @@ class RepairModel:
         repaired_dfs = [self._empty_repaired_cells_frame()]
         if self._repair_by_regex_enabled:
             error_cells_df, by_regex = self._repair_by_regexs(error_cells_df)
-            _record_rule_repairs(by_regex, _prov.REASON_RULE_REGEX)
+            _record_rule_repairs(by_regex, _prov.REASON_RULE_REGEX_STRUCTURE)
             repaired_dfs.append(by_regex)
         if self._repair_by_nearest_values_enabled:
             error_cells_df, by_nv = self._repair_by_nearest_values(
@@ -2188,6 +2206,14 @@ class RepairModel:
             table, continuous_columns, error_cells_df) if not need_pmf else None
         chunk_rows = int(os.environ.get("DELPHI_REPAIR_CHUNK_ROWS", "2000000"))
 
+        # confidence-routed escalation (delphi_tpu/escalate) applies only to
+        # the direct-repair paths: the PMF / maximal-likelihood modes return
+        # distributions, not decisions, so there is nothing to escalate
+        escalate_requested = False
+        if not need_pmf:
+            from delphi_tpu import escalate as _escalate
+            escalate_requested = _escalate.escalation_requested(self)
+
         if maximal_likelihood_repair:
             assert len(continuous_columns) == 0
             assert len(self.cf.targets) == 0  # type: ignore
@@ -2237,7 +2263,7 @@ class RepairModel:
                 pd.concat(pmf_parts, ignore_index=True), compute_repair_prob)
 
         if not (need_pmf or repair_data or self.repair_validation_enabled
-                or self.repair_by_rules) \
+                or self.repair_by_rules or escalate_requested) \
                 and chunk_rows > 0 and len(error_row_pos) > chunk_rows:
             # candidates-only at scale: decode + repair + extract per chunk of
             # dirty rows so no full dirty block ever materializes at once
@@ -2272,6 +2298,21 @@ class RepairModel:
             compute_repair_candidate_prob, maximal_likelihood_repair)
         repaired_rows_df = self._minimize_one_tuple_dc_repairs(
             table, dc_plan, error_row_pos, repaired_rows_df, models)
+
+        if escalate_requested:
+            # after DC minimization, before the result frames are shaped:
+            # the escalated values flow into BOTH the repaired-data concat
+            # and the candidate extraction below
+            from delphi_tpu import escalate as _escalate
+            with phase_span("escalation"):
+                esc_summary = _escalate.maybe_escalate(
+                    self, masked, error_cells_df, error_row_pos,
+                    repaired_rows_df, target_columns, continuous_columns)
+            self._last_escalation = esc_summary
+            from delphi_tpu.observability import current_recorder
+            rec = current_recorder()
+            if rec is not None:
+                rec.escalation = esc_summary
 
         if compute_repair_candidate_prob and not maximal_likelihood_repair:
             pmf_df = self._compute_repair_pmf(
@@ -2472,14 +2513,30 @@ class RepairModel:
             recorder = obs.start_recording(
                 "repair.run", events_path=obs.events_path_for(report_path))
 
+        # the escalation router reads the live provenance ledger; when a run
+        # requests escalation without configuring provenance, arm a
+        # thread-local in-memory ledger (scoped, so concurrent serve
+        # sessions stay isolated and nothing is written to disk)
+        import contextlib
+        esc_scope: Any = contextlib.nullcontext()
+        if not detect_errors_only:
+            from delphi_tpu import escalate as _escalate
+            if _escalate.escalation_requested(self) \
+                    and _prov.active_ledger() is None \
+                    and not _prov.provenance_configured():
+                esc_scope = _prov.scoped_ledger(
+                    _prov.ProvenanceLedger(_prov.MEMORY_PATH))
+
         status: str = "ok"
         error: Optional[str] = None
         run_info: Dict[str, Any] = {}
         try:
-            return self._run_checked(
-                run_info, detect_errors_only, compute_repair_candidate_prob,
-                compute_repair_prob, compute_repair_score, repair_data,
-                maximal_likelihood_repair)
+            with esc_scope:
+                return self._run_checked(
+                    run_info, detect_errors_only,
+                    compute_repair_candidate_prob, compute_repair_prob,
+                    compute_repair_score, repair_data,
+                    maximal_likelihood_repair)
         except BaseException as e:
             status = "error"
             error = f"{type(e).__name__}: {e}"
@@ -2586,6 +2643,7 @@ class RepairModel:
                      compute_repair_prob, compute_repair_score, repair_data,
                      maximal_likelihood_repair)
         self._last_incremental = None
+        self._last_escalation = None
         try:
             with profile_trace("delphi.repair.run"):
                 if incremental.incremental_requested(self):
@@ -2603,6 +2661,8 @@ class RepairModel:
             if prewarm is not None:
                 prewarm.stop()
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")
+        if self._last_escalation is not None:
+            run_info["escalation"] = self._last_escalation
         run_info["elapsed_s"] = round(elapsed, 6)
         run_info["result_rows"] = int(len(df))
         return df

@@ -49,6 +49,9 @@ DECISION_BELOW_THRESHOLD = "below_threshold"
 REASON_MODEL_REPAIR = "model_repair"
 REASON_MAXIMAL_LIKELIHOOD = "maximal_likelihood"
 REASON_RULE_REGEX = "rule_regex"
+# user-supplied RegexStructureRepair rules record under their own label so
+# they stay distinguishable from the escalation plane's INDUCED patterns
+REASON_RULE_REGEX_STRUCTURE = "rule_regex_structure"
 REASON_RULE_NEAREST_VALUE = "rule_nearest_value"
 REASON_PREDICTION_MATCHES_CURRENT = "prediction_matches_current"
 REASON_WEAK_LABEL_CLEAN = "weak_label_clean"
@@ -59,12 +62,21 @@ REASON_CONFIDENCE_UNAVAILABLE = "confidence_unavailable_keep_all"
 REASON_VALIDATION_VIOLATION = "validation_violation"
 REASON_BELOW_SCORE_THRESHOLD = "below_score_threshold"
 REASON_NO_REPAIR_ATTEMPTED = "no_repair_attempted"
+# escalation-tier decisions (delphi_tpu/escalate): one reason per tier so
+# an audit can separate induced-pattern, joint-inference, and external-
+# adapter repairs from the statistical pipeline's
+REASON_ESCALATED_PATTERN = "escalated_pattern"
+REASON_ESCALATED_JOINT = "escalated_joint"
+REASON_ESCALATED_ADAPTER = "escalated_adapter"
 
 # Reasons a later, more generic decision pass (candidate extraction) must
 # not overwrite: they carry WHY the generic outcome happened.
 _STICKY_REASONS = frozenset({
     REASON_DC_MINIMIZED, REASON_CONFIDENCE_UNAVAILABLE,
-    REASON_RULE_REGEX, REASON_RULE_NEAREST_VALUE,
+    REASON_RULE_REGEX, REASON_RULE_REGEX_STRUCTURE,
+    REASON_RULE_NEAREST_VALUE,
+    REASON_ESCALATED_PATTERN, REASON_ESCALATED_JOINT,
+    REASON_ESCALATED_ADAPTER,
 })
 
 CONFIDENCE_BINS = 20
@@ -297,6 +309,35 @@ class ProvenanceLedger:
             if repaired is not None:
                 e["repaired"] = _spell(repaired)
 
+    # -- phase 3b: escalation ----------------------------------------------
+
+    def record_escalation_routed(self, row_id: Any, attr: str,
+                                 route_reason: str) -> None:
+        """Marks a cell the escalation router selected (whether or not any
+        tier ends up repairing it) — the scorecards' routed counts come
+        from these marks."""
+        with self._lock:
+            e = self._entry(str(row_id), str(attr))
+            e["escalation_routed"] = route_reason
+
+    def record_escalation(self, row_id: Any, attr: str, tier: str,
+                          reason: str, repaired: Any,
+                          confidence: Any = None) -> None:
+        """Final decision from an escalation tier: repaired, with the tier
+        stamped on the entry. The reason is sticky — the extraction pass's
+        generic ``model_repair`` must not overwrite it."""
+        with self._lock:
+            e = self._entry(str(row_id), str(attr))
+            e["decision"] = DECISION_REPAIRED
+            e["decision_reason"] = reason
+            e["repaired"] = _spell(repaired)
+            e["escalation_tier"] = str(tier)
+            if confidence is not None:
+                try:
+                    e["escalation_confidence"] = round(float(confidence), 6)
+                except (TypeError, ValueError):
+                    pass
+
     def clear_decision(self, row_id: Any, attr: str) -> None:
         """Undo a provisional decision (the DC fixpoint pass restoring a
         reverted repair) so the extraction pass re-derives it."""
@@ -401,6 +442,7 @@ def _empty_card() -> Dict[str, Any]:
         "domain_size": {"count": 0, "sum": 0, "min": None, "max": None,
                         "hist": {}},
         "repaired_values": {},
+        "escalation": {"routed": 0, "routed_reasons": {}, "repairs": {}},
     }
 
 
@@ -452,6 +494,16 @@ def build_scorecards(entries: Iterable[Dict[str, Any]],
             hist = card["domain_size"]["hist"]
             b = _size_bucket(int(ds))
             hist[b] = hist.get(b, 0) + 1
+        route = e.get("escalation_routed")
+        if route:
+            esc = card["escalation"]
+            esc["routed"] += 1
+            esc["routed_reasons"][route] = \
+                esc["routed_reasons"].get(route, 0) + 1
+        tier = e.get("escalation_tier")
+        if tier:
+            reps = card["escalation"]["repairs"]
+            reps[tier] = reps.get(tier, 0) + 1
     for attr, card in cards.items():
         if model_scores and attr in model_scores:
             card["model_cv_score"] = round(model_scores[attr], 6)
@@ -513,6 +565,12 @@ def merge_scorecards(cards_list: Sequence[Optional[Dict[str, Any]]]) \
             for b, v in card.get("domain_size", {}).get("hist", {}).items():
                 m["domain_size"]["hist"][b] = \
                     m["domain_size"]["hist"].get(b, 0) + v
+            esc_src = card.get("escalation", {})
+            esc_dst = m["escalation"]
+            esc_dst["routed"] += esc_src.get("routed", 0)
+            for field in ("routed_reasons", "repairs"):
+                for k, v in esc_src.get(field, {}).items():
+                    esc_dst[field][k] = esc_dst[field].get(k, 0) + v
             if "model_cv_score" in card and "model_cv_score" not in m:
                 m["model_cv_score"] = card["model_cv_score"]
     for card in merged.values():

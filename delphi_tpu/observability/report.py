@@ -2,11 +2,11 @@
 ``RepairModel.run()`` (and by ``bench.py``) when ``DELPHI_METRICS_PATH`` /
 ``repair.metrics.path`` is set.
 
-Schema (version 3; version 1/2 reports still load, see
+Schema (version 5; version 1-4 reports still load, see
 :func:`load_run_report`)::
 
     {
-      "schema_version": 3,
+      "schema_version": 5,
       "kind": "delphi_tpu.run_report",
       "created_at": "<ISO-8601 UTC>",
       "status": "ok" | "error" | "running",  # "running" from /report only
@@ -28,10 +28,23 @@ Schema (version 3; version 1/2 reports still load, see
                         confidence: {count, sum, min, max, mean, bins: [],
                                      low_confidence_fraction},
                         domain_size: {count, sum, min, max, mean, hist: {}},
-                        repaired_values: {}, [model_cv_score]}
+                        repaired_values: {},
+                        escalation: {routed, routed_reasons: {},
+                                     repairs: {}},  # v5+
+                        [model_cv_score]}
       },
       "drift": null | {...},                 # v3+: --baseline-report runs
-      "incremental": null | {...}            # v4+: incremental (delta) runs
+      "incremental": null | {...},           # v4+: incremental (delta) runs
+      "escalation": null | {                 # v5+: escalation-tier runs
+        "requested": true, "conf_threshold": 0.5,
+        "routed": 0, "escalated": 0,
+        "budget": {limit, spent, exhausted},
+        "tiers": {"pattern": {attempts, repairs},
+                  "joint": {attempts, repairs},
+                  "adapter": {allowed, calls, attempts, repairs}},
+        "routed_cells": [[row_id, attribute], ...],       # capped
+        "escalated_cells": [[row_id, attribute, tier, value], ...]
+      }
     }
 
 On a multi-host cluster every rank's registry state and span tree travel
@@ -57,8 +70,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 4
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
+REPORT_SCHEMA_VERSION = 5
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -329,6 +342,7 @@ def build_run_report(recorder: Any,
         "scorecards": scorecards,
         "drift": getattr(recorder, "drift", None),
         "incremental": getattr(recorder, "incremental", None),
+        "escalation": getattr(recorder, "escalation", None),
     }
 
 
@@ -358,11 +372,11 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1/v2/v3 -> v4 upgrade: each version only adds keys (v2
-    added ``per_process``, v3 added ``scorecards`` and ``drift``, v4 added
-    ``incremental``), so an older report becomes a valid v4 one by
-    defaulting them. Consumers can rely on the v4 shape regardless of the
-    file's age."""
+    """In-memory v1/v2/v3/v4 -> v5 upgrade: each version only adds keys
+    (v2 added ``per_process``, v3 added ``scorecards`` and ``drift``, v4
+    added ``incremental``, v5 added ``escalation``), so an older report
+    becomes a valid v5 one by defaulting them. Consumers can rely on the
+    v5 shape regardless of the file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
@@ -371,6 +385,7 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("scorecards", None)    # v2 -> v3
     report.setdefault("drift", None)         # v2 -> v3
     report.setdefault("incremental", None)   # v3 -> v4
+    report.setdefault("escalation", None)    # v4 -> v5
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

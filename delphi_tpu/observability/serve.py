@@ -110,6 +110,14 @@ _SEED_COUNTERS = (
     "resilience.checkpoint.hits", "resilience.checkpoint.misses",
     "resilience.checkpoint.stale", "resilience.checkpoint.corrupt",
     "resilience.checkpoint.saves",
+    "escalation.routed", "escalation.escalated",
+    "escalation.budget_exhausted",
+    "escalation.pattern.induced", "escalation.pattern.attempts",
+    "escalation.pattern.repairs",
+    "escalation.joint.launches", "escalation.joint.cells",
+    "escalation.joint.proposals", "escalation.joint.repairs",
+    "escalation.adapter.calls", "escalation.adapter.repairs",
+    "escalation.adapter.call_budget_exhausted",
 )
 
 
@@ -592,6 +600,12 @@ class RepairServer:
                 job.response["base_snapshot"] = str(base_snapshot)
                 job.response["incremental"] = getattr(
                     model, "_last_incremental", None)
+            # per-request escalation rides the generic options loop above
+            # (repair.escalate / .conf / .budget / .adapter); echo the
+            # summary so the caller sees what was routed and escalated
+            esc_summary = getattr(model, "_last_escalation", None)
+            if esc_summary is not None:
+                job.response["escalation"] = esc_summary
             counter_inc("serve.completed")
         except resilience.DeadlineExceeded as e:
             counter_inc("serve.deadline_expired")

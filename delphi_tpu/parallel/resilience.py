@@ -291,6 +291,7 @@ KNOWN_SITES = frozenset({
     "detect.group_extrema",
     "gbdt.cv_chunk",
     "gbdt.fit_chunk",
+    "escalate.joint",
 })
 
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")

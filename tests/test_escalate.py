@@ -1,0 +1,319 @@
+"""Escalation tier (delphi_tpu/escalate/): router selection, the induced
+pattern tier, the joint-inference kernel and its fixed point, budget
+semantics, adapter gating (including the static single-gatekeeper guard),
+and the end-to-end bench A/B (bench.escalate_smoke — escalation off is
+bit-identical to baseline, on repairs only routed cells without regressing
+F1 on the fixture's ground truth)."""
+
+import inspect
+import os
+import pathlib
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bench
+import delphi_tpu
+from delphi_tpu import delphi
+from delphi_tpu import escalate as esc
+from delphi_tpu.escalate import adapter as esc_adapter
+from delphi_tpu.escalate import patterns as esc_patterns
+from delphi_tpu.escalate.joint import run_joint_tier
+from delphi_tpu.escalate.router import (
+    ROUTE_CONFIDENCE_UNAVAILABLE, ROUTE_DC_KEEP_ALL, ROUTE_LOW_CONFIDENCE,
+    Budget, RoutedCell, select_candidates,
+)
+from delphi_tpu.observability import provenance as _prov
+from delphi_tpu.ops.joint import NEG_INF, joint_beliefs
+from delphi_tpu.table import encode_table
+
+_ENV = ("DELPHI_ESCALATE", "DELPHI_ESCALATE_CONF", "DELPHI_ESCALATE_BUDGET",
+        "DELPHI_ESCALATE_ITERS", "DELPHI_ESCALATE_ADAPTER",
+        "DELPHI_ESCALATE_ADAPTER_CALLS", "DELPHI_PROVENANCE_PATH")
+
+
+@pytest.fixture(autouse=True)
+def _clean_escalate_env():
+    saved = {v: os.environ.get(v) for v in _ENV}
+    for v in _ENV:
+        os.environ.pop(v, None)
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+
+
+# ---------------------------------------------------------------- router --
+
+def _entry(rid, attr, reason=None, conf=None):
+    return {"row_id": rid, "attribute": attr, "decision_reason": reason,
+            "confidence": conf}
+
+
+def test_router_routes_by_confidence_and_reason():
+    index = {(r, "a"): (int(r), f"v{r}") for r in "0123456"}
+    entries = [
+        _entry("0", "a", conf=0.2),                    # low confidence
+        _entry("1", "a", conf=0.9),                    # confident: no route
+        _entry("2", "a"),                              # no confidence at all
+        _entry("3", "a", reason=_prov.REASON_CONFIDENCE_UNAVAILABLE),
+        _entry("4", "a", reason=_prov.REASON_WEAK_LABEL_CLEAN, conf=0.1),
+        _entry("5", "b", conf=0.1),                    # attr not targeted
+        _entry("9", "a", conf=0.1),                    # not an error cell
+        _entry("6", "a", conf=0.4),
+    ]
+    cands = select_candidates(entries, index, 0.5, ["a"])
+    routes = {c.row_id: c.route_reason for c in cands}
+    assert routes == {"0": ROUTE_LOW_CONFIDENCE,
+                      "2": ROUTE_CONFIDENCE_UNAVAILABLE,
+                      "3": ROUTE_DC_KEEP_ALL,
+                      "6": ROUTE_LOW_CONFIDENCE}
+    # most-uncertain-first: missing confidence, then ascending confidence
+    assert [c.row_id for c in cands] == ["2", "3", "0", "6"]
+    assert cands[2].current_value == "v0"
+    assert cands[2].row_pos == 0
+
+
+def test_budget_take_and_exhaustion():
+    b = Budget(2)
+    assert b.take() and b.take()
+    assert b.remaining() == 0 and not b.exhausted
+    assert not b.take()
+    assert b.exhausted and b.spent == 2
+    assert Budget(0).take() is False
+
+
+# -------------------------------------------------------------- patterns --
+
+def test_induce_pattern_repairs_broken_separator():
+    clean = [f"{100 + i % 7}-{10 + i % 8}" for i in range(40)]
+    pattern = esc_patterns.induce_pattern(clean)
+    assert pattern is not None and pattern.startswith("^")
+    rep = esc_patterns.InducedPatternRepair(pattern)
+    assert rep.matches("104-12")
+    assert rep.repair("104x12") == "104-12"
+    assert rep.repair("104-12") is None      # already structural: untouched
+    assert rep.repair(None) is None
+
+
+def test_induce_pattern_refuses_unstable_structure():
+    # free text: below the support threshold, must never induce
+    assert esc_patterns.induce_pattern(
+        ["alpha beta", "x", "12 monkeys", "no-no_1", "tail spin",
+         "a-1", "bb", "9", "c c c", "zz_9"]) is None
+    # constants-only (one literal) and patterns-only (no anchor literal)
+    assert esc_patterns.induce_pattern(["abc"] * 10) is None
+    assert esc_patterns.induce_pattern(
+        [str(10 + i) for i in range(10)]) is None
+    # 8/10 support is under MIN_SUPPORT=0.9
+    assert esc_patterns.induce_pattern(
+        [f"10{i}-11" for i in range(8)] + ["ab-12", "cd-13"]) is None
+    assert esc_patterns.induce_pattern(["1-2"]) is None   # below MIN_CLEAN
+
+
+# -------------------------------------------------------- joint inference --
+
+def _chain_fixture():
+    """Three cells in one row, V=4: cell 0 has strong unary evidence for
+    value 1; cells 1 and 2 have flat unaries and learn it only through the
+    equality-shaped pairwise chain 0 -> 1 -> 2."""
+    V, K = 4, 2
+    unary = np.zeros((3, V), dtype=np.float32)
+    unary[0, 1] = 5.0
+    eq = np.eye(V, dtype=np.float32) * 4.0
+    nbr_idx = np.full((3, K), -1, dtype=np.int32)
+    nbr_pot = np.zeros((3, K, V, V), dtype=np.float32)
+    nbr_idx[1, 0], nbr_pot[1, 0] = 0, eq
+    nbr_idx[2, 0], nbr_pot[2, 0] = 1, eq
+    return unary, nbr_idx, nbr_pot
+
+
+def test_joint_kernel_converges_to_fixed_point():
+    unary, nbr_idx, nbr_pot = _chain_fixture()
+    b32 = joint_beliefs(unary, nbr_idx, nbr_pot, 32)
+    b64 = joint_beliefs(unary, nbr_idx, nbr_pot, 64)
+    np.testing.assert_allclose(b32.sum(axis=1), 1.0, atol=1e-5)
+    # converged: doubling the iterations no longer moves the beliefs
+    np.testing.assert_allclose(b32, b64, atol=1e-5)
+    # the evidence propagated down the whole chain
+    assert list(np.argmax(b64, axis=1)) == [1, 1, 1]
+    assert float(b64[2, 1]) > 0.8
+
+
+def test_joint_kernel_bit_deterministic():
+    unary, nbr_idx, nbr_pot = _chain_fixture()
+    a = joint_beliefs(unary, nbr_idx, nbr_pot, 16)
+    b = joint_beliefs(unary, nbr_idx, nbr_pot, 16)
+    assert np.array_equal(a, b)
+
+
+def test_run_joint_tier_recovers_correlated_cells():
+    """y and z are functions of the observed x; both unknowns share row 0,
+    so the tier must recover them through context + neighbor coupling."""
+    n = 64
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "x": [f"x{i % 4}" for i in range(n)],
+        "y": [f"y{i % 4}" for i in range(n)],
+        "z": [f"z{i % 4}" for i in range(n)],
+    })
+    df.loc[0, "y"] = None
+    df.loc[0, "z"] = None
+    masked = encode_table(df, "tid")
+    cells = [RoutedCell("0", "y", 0, None, None, ROUTE_CONFIDENCE_UNAVAILABLE),
+             RoutedCell("0", "z", 0, None, None, ROUTE_CONFIDENCE_UNAVAILABLE)]
+    props = run_joint_tier(masked, cells, 0.5, 16)
+    assert {(p.cell.attribute, p.value) for p in props} == \
+        {("y", "y0"), ("z", "z0")}
+    assert all(p.belief >= 0.5 for p in props)
+    # bit-deterministic across runs
+    again = run_joint_tier(masked, cells, 0.5, 16)
+    assert [(p.cell.key, p.value, p.belief) for p in props] == \
+        [(p.cell.key, p.value, p.belief) for p in again]
+
+
+# ------------------------------------------------------------ end-to-end --
+
+def _repair(session, tag, df, options=None):
+    """One full repair run; returns (sorted candidates frame, escalation
+    summary or None)."""
+    from delphi_tpu import NullErrorDetector, RegExErrorDetector
+
+    name = f"esc_test_{tag}"
+    session.register(name, df.copy())
+    model = delphi.repair \
+        .setTableName(name) \
+        .setRowId("tid") \
+        .setErrorDetectors([
+            NullErrorDetector(),
+            RegExErrorDetector("c2", "^[0-9]{3}-[0-9]{2}$"),
+        ])
+    for key, value in (options or {}).items():
+        model = model.option(key, value)
+    out = model.run()
+    frame = out.sort_values(list(out.columns)).reset_index(drop=True)
+    return frame, getattr(model, "_last_escalation", None)
+
+
+def test_escalation_off_is_default_and_none(session):
+    df, _ = bench._escalate_frames(64)
+    _, summary = _repair(session, "off_default", df)
+    assert summary is None
+
+
+def test_escalated_repairs_bit_deterministic(session):
+    df, _ = bench._escalate_frames(64)
+    f1, s1 = _repair(session, "det_a", df, {"repair.escalate": "true"})
+    f2, s2 = _repair(session, "det_b", df, {"repair.escalate": "true"})
+    pd.testing.assert_frame_equal(f1, f2)
+    assert s1["escalated_cells"] == s2["escalated_cells"]
+    assert s1["routed_cells"] == s2["routed_cells"]
+    assert s1["escalated"] > 0
+
+
+def test_budget_exhaustion_keeps_applied_escalations(session):
+    df, _ = bench._escalate_frames(64)
+    full, s_full = _repair(session, "budget_full", df,
+                           {"repair.escalate": "true"})
+    capped, s_cap = _repair(session, "budget_cap", df,
+                            {"repair.escalate": "true",
+                             "repair.escalate.budget": "3"})
+    assert s_full["escalated"] > 3 >= s_cap["escalated"] > 0
+    assert s_cap["budget"]["exhausted"] is True
+    assert s_cap["budget"]["spent"] <= 3
+    # the budget stopped routing MID-TIER: later tiers saw no cells
+    assert s_cap["tiers"]["joint"]["attempts"] == 0
+    # ...but every escalation applied before exhaustion is in the output
+    cells = {(str(r), str(a)): v for r, a, v in
+             zip(capped["tid"], capped["attribute"], capped["repaired"])}
+    for rid, attr, tier, value in s_cap["escalated_cells"]:
+        assert cells[(rid, attr)] == value
+
+
+def test_escalation_requested_parses_explicit_false(session):
+    assert esc.escalation_requested(
+        delphi.repair.option("repair.escalate", "false")) is False
+    assert esc.escalation_requested(
+        delphi.repair.option("repair.escalate", "true")) is True
+    assert esc.escalation_requested(delphi.repair) is False
+    os.environ["DELPHI_ESCALATE"] = "1"
+    assert esc.escalation_requested(delphi.repair) is True
+
+
+# ----------------------------------------------------------- adapter tier --
+
+def test_adapter_hard_off_by_default(session, monkeypatch):
+    # no env, no option, no conf -> the gatekeeper refuses to construct
+    assert esc_adapter.adapter_allowed(None) is False
+    assert esc_adapter.resolve_adapter(None) is None
+    # runtime proof: a full escalating run must never touch adapter code
+    def _boom(self, batch):
+        raise AssertionError("adapter tier reached without explicit enable")
+    monkeypatch.setattr(esc_adapter.MockAdapter, "repair", _boom)
+    df, _ = bench._escalate_frames(64)
+    _, summary = _repair(session, "adapter_off", df,
+                         {"repair.escalate": "true"})
+    assert summary["tiers"]["adapter"] == {
+        "allowed": False, "calls": 0, "attempts": 0, "repairs": 0}
+
+
+def test_adapter_mock_when_explicitly_enabled(session):
+    df, _ = bench._escalate_frames(64)
+    _, summary = _repair(session, "adapter_on", df,
+                         {"repair.escalate": "true",
+                          "repair.escalate.adapter": "mock"})
+    tier = summary["tiers"]["adapter"]
+    assert tier["allowed"] is True
+    assert 0 < tier["calls"] <= esc_adapter.adapter_call_limit()
+    assert tier["repairs"] > 0
+    assert any(t == esc.TIER_ADAPTER
+               for _, _, t, _ in summary["escalated_cells"])
+
+
+def test_adapter_spec_falsy_spellings_stay_off():
+    for spelling in ("", "0", "false", "no", "off", " False "):
+        os.environ["DELPHI_ESCALATE_ADAPTER"] = spelling
+        assert esc_adapter.adapter_allowed(None) is False
+        assert esc_adapter.resolve_adapter(None) is None
+    os.environ["DELPHI_ESCALATE_ADAPTER"] = "mock"
+    assert isinstance(esc_adapter.resolve_adapter(None),
+                      esc_adapter.MockAdapter)
+
+
+def test_adapter_static_guard_single_gatekeeper():
+    """The adapter tier is constructible through resolve_adapter ONLY, and
+    resolve_adapter's first act is the allow check — so no code path can
+    reach an adapter unless DELPHI_ESCALATE_ADAPTER is explicitly set."""
+    root = pathlib.Path(delphi_tpu.__file__).parent
+    construct = re.compile(r"\bMockAdapter\(|\bRepairAdapter\(")
+    resolve = re.compile(r"\bresolve_adapter\(")
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        text = path.read_text()
+        if construct.search(text):
+            assert rel == "escalate/adapter.py", \
+                f"adapter constructed outside the gatekeeper: {rel}"
+        if resolve.search(text):
+            assert rel in ("escalate/adapter.py", "escalate/__init__.py"), \
+                f"unexpected resolve_adapter call site: {rel}"
+    import ast
+    fn = ast.parse(inspect.getsource(esc_adapter.resolve_adapter)).body[0]
+    stmts = [s for s in fn.body
+             if not (isinstance(s, ast.Expr)
+                     and isinstance(s.value, ast.Constant))]  # drop docstring
+    first = stmts[0]
+    assert isinstance(first, ast.If) \
+        and "adapter_allowed" in ast.dump(first.test), \
+        "resolve_adapter must gate on adapter_allowed before anything else"
+
+
+# -------------------------------------------------------------- bench A/B --
+
+def test_bench_escalate_smoke_ab(session):
+    """bench.escalate_smoke: off bit-identical to baseline; on routes,
+    repairs only routed cells via pattern/joint, improves F1, adapter off."""
+    assert bench.escalate_smoke() == 0

@@ -168,6 +168,36 @@ def test_scorecard_merge_matches_single_build():
     assert merged["c1"]["repaired_values"] == {"x": 10, "y": 30}
 
 
+def test_scorecard_escalation_section():
+    """Escalation routing and per-tier repairs aggregate into the scorecard
+    `escalation` section and survive the cross-host merge."""
+    led = ProvenanceLedger(provenance.MEMORY_PATH)
+    for i in range(4):
+        led.record_decision(str(i), "c1", DECISION_REPAIRED,
+                            REASON_MODEL_REPAIR, repaired="x")
+        led.record_escalation_routed(str(i), "c1", "low_confidence")
+    led.record_escalation("0", "c1", "pattern",
+                          provenance.REASON_ESCALATED_PATTERN, "104-12")
+    led.record_escalation("1", "c1", "joint",
+                          provenance.REASON_ESCALATED_JOINT, "104-13",
+                          confidence=0.8)
+    cards = build_scorecards(led.entries())
+    esc = cards["c1"]["escalation"]
+    assert esc["routed"] == 4
+    assert esc["routed_reasons"] == {"low_confidence": 4}
+    assert esc["repairs"] == {"pattern": 1, "joint": 1}
+    # escalated decisions carry their tier's own reason
+    by_id = {e["row_id"]: e for e in led.entries()}
+    assert by_id["0"]["decision_reason"] == \
+        provenance.REASON_ESCALATED_PATTERN
+    assert by_id["1"]["escalation_tier"] == "joint"
+    # exact merge: two half-ledgers sum to the whole
+    merged = merge_scorecards([cards, cards])
+    assert merged["c1"]["escalation"]["routed"] == 8
+    assert merged["c1"]["escalation"]["repairs"] == {"pattern": 2,
+                                                     "joint": 2}
+
+
 def test_drift_identical_runs_do_not_trip():
     cards = build_scorecards(_entries(20, "c1", 0.9, "x"))
     baseline = {"scorecards": cards}

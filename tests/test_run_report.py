@@ -278,6 +278,32 @@ def test_v3_report_upgrades_on_load(tmp_path):
     assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
     assert loaded["schema_version_loaded_from"] == 3
     assert loaded["incremental"] is None
+    assert loaded["escalation"] is None
+
+
+def test_v4_report_upgrades_on_load(tmp_path):
+    v4 = {"schema_version": 4, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"},
+          "per_process": None, "scorecards": None, "drift": None,
+          "incremental": {"mode": "delta"}}
+    path = tmp_path / "v4.json"
+    path.write_text(json.dumps(v4))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert loaded["schema_version_loaded_from"] == 4
+    assert loaded["incremental"] == {"mode": "delta"}  # payload untouched
+    assert loaded["escalation"] is None
+
+
+def test_run_report_carries_escalation_summary():
+    rec = obs.start_recording("esc_report")
+    rec.escalation = {"requested": True, "routed": 2, "escalated": 1}
+    obs.stop_recording(rec)
+    report = obs.build_run_report(rec)
+    assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert report["escalation"] == {"requested": True, "routed": 2,
+                                    "escalated": 1}
 
 
 def test_write_run_report_is_atomic(tmp_path):

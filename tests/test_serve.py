@@ -154,7 +154,11 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
                      "delphi_serve_deadline_expired",
                      "delphi_resilience_retries",
                      "delphi_resilience_checkpoint_corrupt",
-                     "delphi_resilience_plan_unmatched"):
+                     "delphi_resilience_plan_unmatched",
+                     "delphi_escalation_routed",
+                     "delphi_escalation_escalated",
+                     "delphi_escalation_joint_launches",
+                     "delphi_escalation_adapter_calls"):
             assert name in metrics, f"{name} not pre-seeded on /metrics"
 
         # deadline expiry -> 504, structured status, worker reclaimed
@@ -197,6 +201,68 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
     leftover = [t.name for t in threading.enumerate()
                 if t.name.startswith("delphi-serve")]
     assert leftover == []
+
+
+def test_concurrent_escalating_request_is_isolated():
+    """Per-request escalation under RequestScope: of two concurrent
+    /repair requests on the same table, only the one carrying the
+    repair.escalate.* options escalates; the plain request's frame stays
+    bit-identical to a solo baseline, and no escalation state leaks into
+    later requests (options are per-model, never env)."""
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_test_")
+    srv = RepairServer(port=0, workers=2, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        port = srv.port
+        status, base, _ = _post(port, "/repair", _payload(request_id="base"))
+        assert status == 200 and base["status"] == "ok"
+        assert "escalation" not in base
+        f0 = base["frame"]
+
+        esc_opts = {"repair.escalate": "true",
+                    "repair.escalate.conf": "0.9",
+                    "repair.escalate.adapter": "mock"}
+        results = {}
+
+        def call(tag, payload):
+            results[tag] = _post(port, "/repair", payload)
+
+        threads = [
+            threading.Thread(target=call, args=(
+                "esc", _payload(request_id="esc", options=esc_opts))),
+            threading.Thread(target=call, args=(
+                "plain", _payload(request_id="plain"))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+
+        status_p, plain, _ = results["plain"]
+        assert status_p == 200 and plain["status"] == "ok"
+        assert "escalation" not in plain
+        assert plain["frame"] == f0  # bit-identical to the solo baseline
+
+        status_e, escalated, _ = results["esc"]
+        assert status_e == 200 and escalated["status"] == "ok"
+        summary = escalated["escalation"]
+        assert summary["requested"] is True
+        assert summary["routed"] >= 1
+        assert summary["tiers"]["adapter"]["allowed"] is True
+        assert summary["escalated"] >= 1
+        # every escalated decision is visible in THAT request's frame
+        by_cell = {(str(r["tid"]), str(r["attribute"])): r["repaired"]
+                   for r in escalated["frame"]}
+        for rid, attr, _tier, value in summary["escalated_cells"]:
+            assert by_cell[(rid, attr)] == value
+
+        # nothing sticky: a later plain request matches the baseline
+        status, after, _ = _post(port, "/repair", _payload(request_id="aft"))
+        assert status == 200 and "escalation" not in after
+        assert after["frame"] == f0
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 def test_drain_completes_in_flight_request():
