@@ -336,7 +336,10 @@ def smoke() -> int:
     rc = incremental_smoke()
     if rc:
         return rc
-    return escalate_smoke()
+    rc = escalate_smoke()
+    if rc:
+        return rc
+    return dist_chaos_smoke()
 
 
 def _smoke_frame():
@@ -536,6 +539,260 @@ def chaos() -> int:
     fault plan, bit-identical A/B (see chaos_smoke)."""
     _force_cpu_backend()
     return chaos_smoke(_smoke_frame())
+
+
+# Rank-scoped distributed chaos plans (``rank:site:nth:kind``, see
+# resilience.parse_fault_plan). Both target rank 1 so rank 0 is always the
+# survivor that must finish with a complete, bit-identical frame:
+#   stall — rank 1 wedges on its caller thread entering the report-gather
+#     collective (heartbeat #2 has already agreed both ranks are alive), so
+#     rank 0 blocks inside the gather until its watchdog deadline fires;
+#   death — rank 1 hard-exits at its second heartbeat (the stop_recording
+#     sync point), so rank 0's membership gather itself degrades and the
+#     report aggregation is skipped outright.
+DIST_CHAOS_PLANS = {
+    "stall": "1:report.gather:1:stall",
+    "death": "1:dist.heartbeat:2:rank_death",
+}
+
+# Worker for the 2-process localhost CPU cluster. DELPHI_MESH=off keeps the
+# mid-run pipeline collective-free (every sharded branch is gated on
+# process-local ingestion, which this worker does not use), so the only
+# cross-rank sync points are heartbeat #1 (init join), heartbeat #2 and the
+# report gather (both inside stop_recording) — exactly where the plans
+# strike — and the surviving rank's repair math is bit-identical to a plain
+# single-process run by construction.
+_DIST_CHAOS_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["REPO"])
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+rank = sys.argv[1]
+os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
+os.environ["DELPHI_NUM_PROCESSES"] = "2"
+os.environ["DELPHI_PROCESS_ID"] = rank
+os.environ["DELPHI_MESH"] = "off"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import pandas as pd
+from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import observability as obs
+from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+from delphi_tpu.session import get_session
+
+# heartbeat #1 fires inside the init join; both ranks are still healthy on
+# every plan (the chaos targets later sync points)
+assert maybe_initialize_distributed()
+assert jax.process_count() == 2
+
+n = 64
+df = pd.DataFrame({
+    "tid": [str(i) for i in range(n)],
+    "c0": ["a" if i % 2 else "b" for i in range(n)],
+    "c1": [str(i % 4) for i in range(n)],
+    "c2": [str((i * 7) % 5) for i in range(n)],
+})
+df.loc[df.index % 11 == 0, "c1"] = None
+
+get_session().register("dist_chaos", df)
+rec = obs.start_recording("bench.dist_chaos.r" + rank)
+try:
+    out = delphi.repair \
+        .setTableName("dist_chaos") \
+        .setRowId("tid") \
+        .setErrorDetectors([NullErrorDetector()]) \
+        .run()
+finally:
+    # heartbeat #2 + the report.gather collective fire in here: the chaos
+    # plans wedge/kill rank 1 at exactly these sync points
+    obs.stop_recording(rec)
+
+if rank == "0":
+    counters = rec.registry.snapshot()["counters"]
+    report = obs.build_run_report(rec, run={}, status="ok")
+    frame = out.sort_values(list(out.columns)).reset_index(drop=True)
+    frame.to_json(os.environ["OUT"] + ".frame.json", orient="split")
+    with open(os.environ["OUT"] + ".result.json", "w") as f:
+        json.dump({
+            "resilience": {k: int(v) for k, v in counters.items()
+                           if k.startswith("resilience.")},
+            "schema_version": report["schema_version"],
+            "dist": report["dist"],
+        }, f)
+print("DIST_CHAOS_WORKER_OK rank=" + rank, flush=True)
+sys.stdout.flush()
+sys.stderr.flush()
+# hard exit: a wedged watchdog thread (or the dead peer's half-closed
+# coordination channel) must not hang interpreter teardown
+os._exit(0)
+"""
+
+
+def dist_chaos_smoke() -> int:
+    """Distributed resilience A/B: a 2-process localhost CPU cluster runs
+    the smoke repair under each rank-scoped DIST_CHAOS_PLANS entry (rank 1
+    stalls inside a collective; rank 1 dies outright). Rank 0 must survive
+    via the guarded-collective deadline — classify ``rank_loss``, latch
+    single-host execution, degrade report aggregation to its own view —
+    and still produce a frame BIT-IDENTICAL to a clean single-process run.
+    Prints one JSON line; exit code 1 on failure."""
+    import socket
+    import tempfile
+
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.session import get_session
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="delphi_dist_chaos_")
+
+    # clean single-process reference, in this process; DELPHI_MESH=off to
+    # match the workers (the parent may expose several host devices)
+    _heartbeat("dist chaos: clean single-process reference")
+    prev_mesh = os.environ.get("DELPHI_MESH")
+    os.environ["DELPHI_MESH"] = "off"
+    get_session().register("dist_chaos_ref", _smoke_frame())
+    try:
+        ref = delphi.repair \
+            .setTableName("dist_chaos_ref") \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]) \
+            .run()
+    finally:
+        get_session().drop("dist_chaos_ref")
+        if prev_mesh is None:
+            os.environ.pop("DELPHI_MESH", None)
+        else:
+            os.environ["DELPHI_MESH"] = prev_mesh
+    ref = ref.sort_values(list(ref.columns)).reset_index(drop=True)
+    ref_path = os.path.join(work, "reference.frame.json")
+    ref.to_json(ref_path, orient="split")
+    # JSON round-trip the reference too so both sides of every frame
+    # comparison carry identical serialization dtypes
+    ref = pd.read_json(ref_path, orient="split", convert_axes=False,
+                       dtype=False)
+
+    worker = os.path.join(work, "dist_chaos_worker.py")
+    with open(worker, "w") as f:
+        f.write(_DIST_CHAOS_WORKER)
+
+    scenarios = {}
+    for scenario, plan in DIST_CHAOS_PLANS.items():
+        _heartbeat(f"dist chaos: {scenario} scenario ({plan})")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DELPHI_MESH",
+                            "DELPHI_FAULT_PLAN", "DELPHI_METRICS_PORT")}
+        env["COORD"] = f"127.0.0.1:{port}"
+        env["REPO"] = repo
+        env["OUT"] = os.path.join(work, scenario)
+        env["DELPHI_FAULT_PLAN"] = plan
+        env["DELPHI_COLLECTIVE_TIMEOUT_S"] = "10"
+        env["DELPHI_HEARTBEAT_S"] = "0.25"
+        env["DELPHI_LIVENESS_DIR"] = os.path.join(work,
+                                                  scenario + "_liveness")
+        env["DELPHI_CHECKPOINT_DIR"] = os.path.join(work, scenario + "_ckpt")
+
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(i)], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for i in range(2)]
+        try:
+            out0, _ = procs[0].communicate(timeout=600)
+            rc0 = procs[0].returncode
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            out0, _ = procs[0].communicate()
+            rc0 = None
+        # the stalled rank 1 is wedged by design — reap it, don't wait long
+        try:
+            out1, _ = procs[1].communicate(
+                timeout=5 if scenario == "stall" else 60)
+            rc1 = procs[1].returncode
+        except subprocess.TimeoutExpired:
+            procs[1].kill()
+            out1, _ = procs[1].communicate()
+            rc1 = None
+
+        payload = {}
+        frames_equal = False
+        try:
+            with open(env["OUT"] + ".result.json") as f:
+                payload = json.load(f)
+            got = pd.read_json(env["OUT"] + ".frame.json", orient="split",
+                               convert_axes=False, dtype=False)
+            pd.testing.assert_frame_equal(got, ref)
+            frames_equal = True
+        except (OSError, ValueError, AssertionError):
+            pass
+        res = payload.get("resilience", {})
+        dist = payload.get("dist") or {}
+        checks = {
+            "survivor_completed": rc0 == 0,
+            "frame_bit_identical": frames_equal,
+            "rank_loss_counted":
+                res.get("resilience.dist.rank_loss", 0) >= 1,
+            "fault_classified":
+                res.get("resilience.faults.rank_loss", 0) >= 1,
+            "single_host_latched":
+                res.get("resilience.dist.single_host_latch", 0) >= 1
+                and dist.get("single_host_latched") is True,
+            "degraded_ranks_reported": dist.get("degraded_ranks") == [1],
+            "aggregation_incomplete":
+                dist.get("aggregation_incomplete") is True
+                and res.get("resilience.dist.aggregation_incomplete", 0) >= 1,
+            "loss_marker_written": os.path.exists(
+                os.path.join(env["DELPHI_CHECKPOINT_DIR"],
+                             "rank_loss.json")),
+        }
+        if scenario == "stall":
+            checks["collective_timeout_counted"] = \
+                res.get("resilience.dist.collective_timeouts", 0) >= 1
+        if scenario == "death":
+            checks["peer_died_hard"] = rc1 == 17
+        if not all(checks.values()):
+            print(f"dist chaos {scenario} worker tails:\n"
+                  f"--- rank 0 (rc={rc0}) ---\n{out0[-2000:]}\n"
+                  f"--- rank 1 (rc={rc1}) ---\n{out1[-2000:]}",
+                  file=sys.stderr)
+        scenarios[scenario] = {
+            "plan": plan, "rc0": rc0, "rc1": rc1, "checks": checks,
+            "resilience": res, "dist": dist,
+        }
+
+    ok = all(all(s["checks"].values()) for s in scenarios.values())
+    losses = sum(s["resilience"].get("resilience.dist.rank_loss", 0)
+                 for s in scenarios.values())
+    print(json.dumps({
+        "metric": "dist_chaos_smoke", "value": losses,
+        "unit": "rank losses survived", "vs_baseline": None, "ok": ok,
+        "scenarios": scenarios,
+    }), flush=True)
+    if not ok:
+        failed = {name: [c for c, v in s["checks"].items() if not v]
+                  for name, s in scenarios.items()
+                  if not all(s["checks"].values())}
+        print("dist chaos smoke FAILED: the surviving rank must degrade "
+              f"deterministically and keep its frame bit-identical "
+              f"(failed checks: {failed})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def dist_chaos() -> int:
+    """Standalone `bench.py --dist-chaos` entry: 2-process localhost CPU
+    cluster, rank-scoped stall + death fault plans, survivor A/B (see
+    dist_chaos_smoke)."""
+    _force_cpu_backend()
+    return dist_chaos_smoke()
 
 
 def _incremental_frames(n: int = 64):
@@ -1267,6 +1524,16 @@ def main() -> None:
                              "via pattern/joint tiers without regressing "
                              "F1, and the adapter tier stays hard off; "
                              "exits 1 on failure")
+    parser.add_argument("--dist-chaos", dest="dist_chaos",
+                        action="store_true",
+                        help="distributed resilience A/B on a 2-process "
+                             "localhost CPU cluster: rank-scoped fault "
+                             "plans stall and then kill rank 1, asserting "
+                             "rank 0 survives via the guarded-collective "
+                             "deadline (rank_loss, single-host latch, "
+                             "degraded report aggregation) with a frame "
+                             "bit-identical to a clean single-process "
+                             "run; exits 1 on failure")
     parser.add_argument("--serve-chaos", dest="serve_chaos",
                         action="store_true",
                         help="service-mode chaos A/B on the CPU backend: "
@@ -1290,6 +1557,9 @@ def main() -> None:
 
     if args.escalate:
         sys.exit(escalate())
+
+    if args.dist_chaos:
+        sys.exit(dist_chaos())
 
     if args.serve_chaos:
         sys.exit(serve_chaos())

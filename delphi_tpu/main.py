@@ -130,9 +130,21 @@ def main(argv=None) -> int:
                         default="",
                         help="deterministic fault-injection plan for chaos "
                              "testing: comma-separated site:nth:kind triples "
-                             "injected at the guarded launch seam (see "
-                             "docs/source/robustness.rst). Equivalent to "
-                             "DELPHI_FAULT_PLAN / repair.fault.plan")
+                             "(optionally rank-scoped rank:site:nth:kind for "
+                             "multi-process runs) injected at the guarded "
+                             "launch seam (see docs/source/robustness.rst). "
+                             "Equivalent to DELPHI_FAULT_PLAN / "
+                             "repair.fault.plan")
+    parser.add_argument("--collective-timeout-s", dest="collective_timeout_s",
+                        type=float, default=None,
+                        help="watchdog deadline for each cross-rank host "
+                             "collective in a multi-process run: on expiry "
+                             "the wedged/dead peer is classified as a "
+                             "rank_loss fault and this rank degrades to "
+                             "single-host execution instead of hanging "
+                             "(default 120; 0 restores unbounded blocking). "
+                             "Equivalent to DELPHI_COLLECTIVE_TIMEOUT_S / "
+                             "repair.collective.timeout_s")
     parser.add_argument("--incremental", dest="incremental",
                         action="store_true",
                         help="delta-aware repair against the snapshot in "
@@ -187,12 +199,19 @@ def main(argv=None) -> int:
                              "this value")
     args = parser.parse_args(argv)
 
+    session = get_session()
+    if args.collective_timeout_s is not None:
+        # before distributed init: the join's first membership heartbeat
+        # already runs under this deadline
+        session.conf["repair.collective.timeout_s"] = \
+            str(args.collective_timeout_s)
+
     # multi-host: join the cluster before any backend use (no-op when
-    # DELPHI_COORDINATOR is unset)
+    # DELPHI_COORDINATOR is unset); a successful join starts the liveness
+    # toucher and runs the first bounded membership heartbeat
     from delphi_tpu.parallel.distributed import maybe_initialize_distributed
     maybe_initialize_distributed()
 
-    session = get_session()
     if args.serve:
         if args.fault_plan:
             session.conf["repair.fault.plan"] = args.fault_plan

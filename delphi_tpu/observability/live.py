@@ -225,11 +225,19 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/healthz":
-                body = json.dumps({
+                from delphi_tpu.parallel import dist_resilience
+                health = {
                     "status": "ok",
                     "phase": plane.recorder.current_phase,
                     "elapsed_s": round(plane.recorder.elapsed_s(), 3),
-                }).encode()
+                }
+                if dist_resilience.single_host_latched():
+                    # degraded, not dead: the survivor is still making
+                    # progress on the shrunk mesh
+                    health["status"] = "degraded"
+                    health["degraded_ranks"] = \
+                        dist_resilience.degraded_ranks()
+                body = json.dumps(health).encode()
                 self._respond(200, "application/json", body)
             elif path == "/metrics":
                 body = render_prometheus(plane.recorder).encode()

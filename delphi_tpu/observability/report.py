@@ -70,8 +70,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 5
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
+REPORT_SCHEMA_VERSION = 6
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -232,7 +232,13 @@ def gather_per_process(recorder: Any) -> None:
     ``stop_recording``): all-gathers each rank's raw registry state and span
     tree and stores the rank-ordered payload list on
     ``recorder.per_process``. Single-process runs (and runs that never
-    touched jax) are a no-op."""
+    touched jax) are a no-op.
+
+    BOUNDED: a membership heartbeat runs first, then the gather itself
+    goes through the ``report.gather`` guarded-collective site — a dead
+    or wedged peer degrades this rank to its own per-rank report, flagged
+    ``aggregation_incomplete`` in the report's ``dist`` section, instead
+    of hanging at shutdown and losing the report entirely."""
     import sys
 
     if "jax" not in sys.modules:
@@ -242,14 +248,27 @@ def gather_per_process(recorder: Any) -> None:
     if distributed.process_count() == 1:
         return
     from delphi_tpu.observability.provenance import scorecards_for
+    from delphi_tpu.parallel import dist_resilience
 
+    dist_resilience.ensure_membership()
     payload = {
         "process_index": distributed.process_index(),
         "metrics": recorder.registry.export_state(),
         "spans": recorder.root.to_dict(),
         "scorecards": scorecards_for(recorder),
     }
-    recorder.per_process = distributed.allgather_pickled(payload)
+    if dist_resilience.single_host_latched():
+        # peers are gone (heartbeat or an earlier collective degraded):
+        # this rank's own payload is the whole report
+        dist_resilience.mark_aggregation_incomplete()
+        recorder.per_process = [payload]
+    else:
+        recorder.per_process = distributed.allgather_pickled(
+            payload, site="report.gather")
+        if dist_resilience.single_host_latched():
+            # the gather itself timed out and fell back to [payload]
+            dist_resilience.mark_aggregation_incomplete()
+    recorder.dist = dist_resilience.report_section()
 
 
 def _tag_process(span_dict: Dict[str, Any], rank: int) -> None:
@@ -343,6 +362,7 @@ def build_run_report(recorder: Any,
         "drift": getattr(recorder, "drift", None),
         "incremental": getattr(recorder, "incremental", None),
         "escalation": getattr(recorder, "escalation", None),
+        "dist": getattr(recorder, "dist", None),
     }
 
 
@@ -372,11 +392,12 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1/v2/v3/v4 -> v5 upgrade: each version only adds keys
+    """In-memory v1..v5 -> v6 upgrade: each version only adds keys
     (v2 added ``per_process``, v3 added ``scorecards`` and ``drift``, v4
-    added ``incremental``, v5 added ``escalation``), so an older report
-    becomes a valid v5 one by defaulting them. Consumers can rely on the
-    v5 shape regardless of the file's age."""
+    added ``incremental``, v5 added ``escalation``, v6 added ``dist`` —
+    the distributed-resilience section), so an older report becomes a
+    valid v6 one by defaulting them. Consumers can rely on the v6 shape
+    regardless of the file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
@@ -386,6 +407,7 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("drift", None)         # v2 -> v3
     report.setdefault("incremental", None)   # v3 -> v4
     report.setdefault("escalation", None)    # v4 -> v5
+    report.setdefault("dist", None)          # v5 -> v6
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

@@ -110,6 +110,10 @@ _SEED_COUNTERS = (
     "resilience.checkpoint.hits", "resilience.checkpoint.misses",
     "resilience.checkpoint.stale", "resilience.checkpoint.corrupt",
     "resilience.checkpoint.saves",
+    "resilience.dist.rank_loss", "resilience.dist.collective_timeouts",
+    "resilience.dist.single_host_latch", "resilience.dist.mesh_shrunk",
+    "resilience.dist.heartbeats",
+    "resilience.dist.aggregation_incomplete",
     "escalation.routed", "escalation.escalated",
     "escalation.budget_exhausted",
     "escalation.pattern.induced", "escalation.pattern.attempts",

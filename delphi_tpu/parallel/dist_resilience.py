@@ -1,0 +1,453 @@
+"""Distributed resilience plane: bounded collectives, rank liveness, and
+coordinated elastic degrade.
+
+PR 7's resilience plane is strictly single-process: it guards device
+launches, not cross-rank interactions. On a multi-host cluster every
+``allgather_*`` in :mod:`~delphi_tpu.parallel.distributed` is an unbounded
+blocking call, so one wedged or dead rank hangs every healthy rank forever
+— including the report-aggregation collective at ``stop_recording``, which
+then silently loses the whole run report. This module extends the plane
+across ranks:
+
+* :func:`guarded_collective` — the seam every host collective routes
+  through. The collective body runs on a watchdog thread under a
+  configurable deadline (``DELPHI_COLLECTIVE_TIMEOUT_S`` /
+  ``repair.collective.timeout_s``, default 120 s, ``0`` disables); on
+  expiry the fault is classified as ``rank_loss`` and the caller degrades
+  deterministically through its ``fallback`` instead of hanging.
+  Collectives are never retried: a failed collective cannot be re-entered
+  unilaterally (the peers may already have moved on), so ANY classified
+  cross-rank failure degrades immediately — the cluster-scope analog of
+  the PR 7 shrink→evict→CPU-latch ladder is timeout→latch-single-host.
+* **Rank heartbeat / membership** — :func:`ensure_membership` piggybacks a
+  cheap rank-id all-gather on the guarded seam at deterministic sync
+  points (after ``jax.distributed`` init and before report aggregation),
+  so ranks agree on who is alive before entering a sharded phase.
+  Heartbeat collectives run ONLY at such sync points: a background-thread
+  collective would deadlock the cluster (collectives must be entered by
+  every rank in the same order), so only the local **liveness file**
+  toucher (``DELPHI_LIVENESS_DIR`` / ``repair.liveness.dir``, period
+  ``DELPHI_HEARTBEAT_S``) runs on a thread — pure local I/O. After a
+  collective timeout the liveness files diagnose each peer: a stale file
+  means the process died, a fresh one means it is alive but stalled, no
+  file means unknown.
+* **Coordinated degrade** — :func:`declare_rank_lost` counts the loss
+  (``resilience.dist.*``), stamps the provenance ledger, writes a
+  ``rank_loss.json`` marker next to the phase checkpoints
+  (``DELPHI_CHECKPOINT_DIR``: the last completed phase's checkpoint is the
+  consistent barrier a restarted cluster resumes from), and latches
+  **single-host execution** for the remainder of the run: every later
+  collective short-circuits to its local fallback and
+  :func:`~delphi_tpu.parallel.mesh.get_active_mesh` re-enters on the
+  shrunk, process-local mesh (``resilience.dist.mesh_shrunk``).
+
+All clocks and waits are module-level seams (``_monotonic``, ``_wall``,
+``_wait``) so tier-1 tests drive the deadline logic against a fake clock.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from delphi_tpu.observability import counter_inc
+
+_logger = logging.getLogger(__name__)
+
+# injectable time/wait seams (fake-clock tests)
+_monotonic = time.monotonic
+_wall = time.time
+
+
+def _wait(event: threading.Event, timeout_s: float) -> bool:
+    """Waits for the collective worker; True when it finished in time.
+    Module-level seam so tests can force a timeout without sleeping."""
+    return event.wait(timeout_s)
+
+
+# -- configuration -----------------------------------------------------------
+
+def collective_timeout_s() -> float:
+    """Watchdog deadline for one host collective in seconds:
+    ``DELPHI_COLLECTIVE_TIMEOUT_S`` / ``repair.collective.timeout_s``
+    (default 120; generous because phase-2 training gathers real frames).
+    ``0`` disables the watchdog and restores unbounded blocking."""
+    from delphi_tpu.parallel.resilience import _env_or_conf
+    return _env_or_conf("DELPHI_COLLECTIVE_TIMEOUT_S",
+                        "repair.collective.timeout_s", float, 120.0)
+
+
+def heartbeat_interval_s() -> float:
+    """Liveness-file touch period in seconds: ``DELPHI_HEARTBEAT_S`` /
+    ``repair.heartbeat.interval_s`` (default 15; ``0`` disables the
+    toucher thread). A peer's file older than 3x this is considered
+    dead."""
+    from delphi_tpu.parallel.resilience import _env_or_conf
+    return _env_or_conf("DELPHI_HEARTBEAT_S",
+                        "repair.heartbeat.interval_s", float, 15.0)
+
+
+def liveness_dir() -> Optional[str]:
+    """Shared directory for per-rank liveness files
+    (``DELPHI_LIVENESS_DIR`` / ``repair.liveness.dir``), or None when the
+    liveness seam is off (the default). Must be visible to every rank
+    (shared filesystem, or localhost benches) for cross-rank diagnosis."""
+    from delphi_tpu.parallel.resilience import _env_or_conf
+    d = _env_or_conf("DELPHI_LIVENESS_DIR", "repair.liveness.dir", str, "")
+    return d.strip() or None
+
+
+# -- distributed degrade state -----------------------------------------------
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "latched": False, "latch_site": None, "reason": None,
+    "lost": set(), "alive": None, "expected": None,
+    "diagnosis": {}, "aggregation_incomplete": False,
+    "mesh_shrunk": False,
+}
+
+
+def single_host_latched() -> bool:
+    """True after a rank loss: every collective short-circuits to its
+    local fallback and the active mesh shrinks to this process's devices
+    for the remainder of the run."""
+    return _state["latched"]
+
+
+def degraded_ranks() -> List[int]:
+    """Sorted ranks declared lost so far (empty when healthy)."""
+    with _lock:
+        return sorted(_state["lost"])
+
+
+def aggregation_incomplete() -> bool:
+    return _state["aggregation_incomplete"]
+
+
+def mark_aggregation_incomplete() -> None:
+    """Report aggregation degraded to this rank's own view (a peer was
+    lost before or during the ``report.gather`` collective)."""
+    with _lock:
+        first = not _state["aggregation_incomplete"]
+        _state["aggregation_incomplete"] = True
+    if first:
+        counter_inc("resilience.dist.aggregation_incomplete")
+
+
+def note_mesh_shrunk() -> None:
+    """mesh.py reports the first re-entry on the shrunk process-local
+    mesh (counted once per run)."""
+    with _lock:
+        first = not _state["mesh_shrunk"]
+        _state["mesh_shrunk"] = True
+    if first:
+        counter_inc("resilience.dist.mesh_shrunk")
+
+
+def reset_dist_state() -> None:
+    """Forgets latches, lost ranks, and membership (tests / benches that
+    replay scenarios in one process); stops the liveness toucher."""
+    stop_liveness()
+    with _lock:
+        _state.update(latched=False, latch_site=None, reason=None,
+                      lost=set(), alive=None, expected=None,
+                      diagnosis={}, aggregation_incomplete=False,
+                      mesh_shrunk=False)
+
+
+def report_section() -> Optional[Dict[str, Any]]:
+    """The run report's ``dist`` section, or None for single-process runs
+    that never touched the membership protocol (schema v6)."""
+    with _lock:
+        touched = (_state["latched"] or _state["lost"]
+                   or _state["aggregation_incomplete"]
+                   or _state["alive"] is not None)
+        if not touched:
+            return None
+        return {
+            "expected_ranks": _state["expected"],
+            "alive_ranks": (list(_state["alive"])
+                            if _state["alive"] is not None else None),
+            "degraded_ranks": sorted(_state["lost"]),
+            "single_host_latched": bool(_state["latched"]),
+            "latch_site": _state["latch_site"],
+            "reason": _state["reason"],
+            "diagnosis": {str(r): v for r, v in _state["diagnosis"].items()},
+            "aggregation_incomplete": bool(_state["aggregation_incomplete"]),
+            "mesh_shrunk": bool(_state["mesh_shrunk"]),
+        }
+
+
+# -- liveness files ----------------------------------------------------------
+
+_toucher: Dict[str, Any] = {"thread": None, "stop": None}
+
+
+def _liveness_path(rank: int) -> Optional[str]:
+    d = liveness_dir()
+    return os.path.join(d, f"rank_{int(rank)}.alive") if d else None
+
+
+def touch_liveness() -> None:
+    """Writes this rank's liveness stamp (wall-clock seconds as text —
+    file CONTENT, not mtime, so the fake-clock tests and clock-skewed
+    hosts read one consistent timebase). Best-effort: liveness is
+    evidence, never a failure source."""
+    from delphi_tpu.parallel import distributed as dist
+    try:
+        path = _liveness_path(dist.process_index())
+    except Exception:
+        return
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(repr(float(_wall())))
+        os.replace(tmp, path)
+    except Exception as e:  # pragma: no cover - filesystem specific
+        _logger.warning(f"liveness touch failed: {e}")
+
+
+def peer_liveness_age_s(rank: int, now: Optional[float] = None
+                        ) -> Optional[float]:
+    """Seconds since ``rank`` last touched its liveness file, or None
+    when the seam is off / the rank never wrote one."""
+    path = _liveness_path(rank)
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            stamp = float(f.read().strip())
+    except Exception:
+        return None
+    return max(0.0, (now if now is not None else float(_wall())) - stamp)
+
+
+def diagnose_peer(rank: int, now: Optional[float] = None) -> str:
+    """Post-timeout diagnosis for one peer: ``dead`` (stale liveness
+    file — the process stopped touching it), ``stalled`` (fresh file —
+    alive but wedged in or before the collective), or ``unknown`` (no
+    liveness seam / no file)."""
+    age = peer_liveness_age_s(rank, now=now)
+    if age is None:
+        return "unknown"
+    return "stalled" if age <= 3.0 * max(heartbeat_interval_s(), 0.001) \
+        else "dead"
+
+
+def start_liveness() -> bool:
+    """Starts the background liveness toucher (local file I/O only — NO
+    collectives run off-thread; see module docstring). Idempotent; False
+    when the seam is unconfigured or the interval is 0."""
+    interval = heartbeat_interval_s()
+    if liveness_dir() is None or interval <= 0:
+        return False
+    touch_liveness()
+    with _lock:
+        t = _toucher["thread"]
+        if t is not None and t.is_alive():
+            return True
+        stop = threading.Event()
+        t = threading.Thread(target=_touch_loop, args=(stop,),
+                             daemon=True, name="delphi-liveness")
+        _toucher.update(thread=t, stop=stop)
+    t.start()
+    return True
+
+
+def _touch_loop(stop: threading.Event) -> None:
+    while not stop.wait(max(0.05, heartbeat_interval_s())):
+        touch_liveness()
+
+
+def stop_liveness() -> None:
+    with _lock:
+        t, stop = _toucher["thread"], _toucher["stop"]
+        _toucher.update(thread=None, stop=None)
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=1.0)
+
+
+# -- coordinated degrade -----------------------------------------------------
+
+def _write_loss_marker(site: str, reason: str, lost: List[int],
+                       diagnosis: Dict[int, str]) -> None:
+    """Marker next to the phase checkpoints: the last completed phase's
+    checkpoint (saved by the existing PhaseCheckpointStore machinery at
+    every phase boundary) is the consistent barrier a restarted cluster
+    resumes from; the marker records why the mesh shrank."""
+    from delphi_tpu.parallel import distributed as dist
+    from delphi_tpu.parallel import resilience as rz
+    directory = rz.checkpoint_dir()
+    if not directory:
+        return
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "rank_loss.json"), "w") as f:
+            json.dump({"site": site, "reason": reason,
+                       "lost_ranks": sorted(int(r) for r in lost),
+                       "diagnosis": {str(r): v
+                                     for r, v in diagnosis.items()},
+                       "surviving_rank": int(dist.process_index()),
+                       "wall_time": float(_wall())}, f)
+    except Exception as e:  # marker is best-effort evidence
+        _logger.warning(f"failed to write rank_loss marker: {e}")
+
+
+def declare_rank_lost(site: str, *, reason: str) -> List[int]:
+    """A cross-rank interaction at ``site`` failed or timed out: declare
+    every unconfirmed peer lost, diagnose each through the liveness
+    files, count the transitions, checkpoint the marker, and latch
+    single-host execution. Deterministic: same inputs, same transitions
+    — every counter and note below is asserted by the dist-chaos A/B.
+    Returns the ranks newly declared lost."""
+    from delphi_tpu.parallel import distributed as dist
+    from delphi_tpu.parallel import resilience as rz
+    me = dist.process_index()
+    n = dist.process_count()
+    peers = [r for r in range(n) if r != me]
+    diagnosis = {r: diagnose_peer(r) for r in peers}
+    with _lock:
+        new = [r for r in peers if r not in _state["lost"]]
+        _state["lost"].update(peers)
+        first = not _state["latched"]
+        if first:
+            _state["latched"] = True
+            _state["latch_site"] = site
+            _state["reason"] = reason
+        _state["diagnosis"].update(diagnosis)
+        _state["expected"] = max(int(_state["expected"] or 0), n)
+    counter_inc(f"resilience.faults.{rz.KIND_RANK_LOSS}")
+    for _ in new:
+        counter_inc("resilience.dist.rank_loss")
+    if first:
+        counter_inc("resilience.dist.single_host_latch")
+        rz._stamp_ledger("rank_loss", site, rz.KIND_RANK_LOSS)
+        _write_loss_marker(site, reason, new or peers, diagnosis)
+        _logger.warning(
+            f"{site}: rank(s) {sorted(new or peers)} declared lost "
+            f"({reason}); diagnosis {diagnosis} — latching single-host "
+            f"execution for the remainder of the run")
+    return new
+
+
+# -- the guarded collective seam ---------------------------------------------
+
+def guarded_collective(site: str, thunk: Callable[[], Any], *,
+                       fallback: Optional[Callable[[], Any]] = None,
+                       timeout_s: Optional[float] = None) -> Any:
+    """Runs one host collective under the distributed resilience plane.
+
+    Single-process: runs ``thunk`` inline (no watchdog, no seam cost
+    beyond one ``process_count`` read). After a single-host latch:
+    returns ``fallback()`` without touching the collective (the peers
+    are gone — entering would hang). Multi-process: the fault-injection
+    seam fires on the CALLER thread (an injected ``stall`` wedges this
+    rank exactly where a real wedge would), then ``thunk`` runs on a
+    daemon watchdog thread bounded by the deadline. On expiry or on any
+    classified cross-rank failure the rank degrades via
+    :func:`declare_rank_lost` and returns ``fallback()`` — collectives
+    are never retried (see module docstring). Unclassifiable errors
+    re-raise: program bugs must stay loud."""
+    from delphi_tpu.parallel import distributed as dist
+    from delphi_tpu.parallel import resilience as rz
+    rz.maybe_abort()
+    if dist.process_count() <= 1:
+        return thunk()
+    if single_host_latched():
+        if fallback is not None:
+            return fallback()
+        raise rz.RankLost(
+            f"collective at {site} entered after single-host latch "
+            f"(lost ranks {degraded_ranks()}) with no local fallback")
+    try:
+        rz._maybe_inject(site)
+    except rz.FaultInjected as exc:
+        if rz.classify_fault(exc) == rz.KIND_RANK_LOSS \
+                and fallback is not None:
+            declare_rank_lost(site, reason=f"injected rank loss: {exc}")
+            return fallback()
+        raise
+    deadline = collective_timeout_s() if timeout_s is None \
+        else float(timeout_s)
+    if deadline <= 0:
+        return thunk()
+    out: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def _work():
+        try:
+            out["value"] = thunk()
+        except BaseException as e:
+            out["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_work, daemon=True,
+                         name=f"delphi-collective-{site}")
+    t.start()
+    if not _wait(done, deadline):
+        # the wedged collective thread is daemonic and leaks by design
+        # (it cannot be cancelled) — the whole point is that THIS thread
+        # gets to keep making progress
+        counter_inc("resilience.dist.collective_timeouts")
+        _logger.warning(
+            f"{site}: collective timed out after {deadline:.1f}s "
+            f"(DELPHI_COLLECTIVE_TIMEOUT_S) — degrading")
+        declare_rank_lost(
+            site, reason=f"collective timed out after {deadline:.1f}s")
+        if fallback is not None:
+            return fallback()
+        raise rz.RankLost(
+            f"collective operation at {site} timed out after "
+            f"{deadline:.1f}s waiting for remote ranks")
+    if "error" in out:
+        exc = out["error"]
+        kind = rz.classify_fault(exc)
+        if kind is not None and fallback is not None:
+            counter_inc(f"resilience.faults.{kind}")
+            declare_rank_lost(
+                site, reason=f"collective failed "
+                f"({kind}): {type(exc).__name__}: {exc}")
+            return fallback()
+        raise exc
+    return out["value"]
+
+
+# -- rank heartbeat / membership ---------------------------------------------
+
+def ensure_membership(site: str = "dist.heartbeat") -> List[int]:
+    """The rank heartbeat: a cheap rank-id all-gather through the guarded
+    seam, run at deterministic sync points only (after distributed init,
+    before report aggregation — every rank enters in the same order or
+    not at all). Touches this rank's liveness file, records the agreed
+    membership, and returns the alive ranks; a timeout degrades through
+    the standard rank-loss path and returns just this rank."""
+    from delphi_tpu.parallel import distributed as dist
+    me = int(dist.process_index())
+    n = int(dist.process_count())
+    touch_liveness()
+    if n <= 1 or single_host_latched():
+        return [me]
+
+    def _gather():
+        import numpy as np
+        from jax.experimental import multihost_utils
+        return [int(r) for r in np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([me], dtype=np.int32))).reshape(-1)]
+
+    alive = guarded_collective(site, _gather, fallback=lambda: [me])
+    alive = sorted(set(alive))
+    with _lock:
+        _state["alive"] = list(alive)
+        _state["expected"] = max(int(_state["expected"] or 0), n)
+    counter_inc("resilience.dist.heartbeats")
+    return alive

@@ -15,7 +15,16 @@ info):
     DELPHI_PROCESS_ID=<i>            optional when the launcher provides it
 
 Single-process runs (no DELPHI_COORDINATOR) are a no-op.
-"""
+
+Every host collective below routes through
+:func:`~delphi_tpu.parallel.dist_resilience.guarded_collective` — a
+bounded watchdog seam (``DELPHI_COLLECTIVE_TIMEOUT_S``) that classifies a
+wedged or dead peer as a ``rank_loss`` fault and degrades to the local
+fallback instead of hanging forever. Each collective carries a registered
+site name (``dist.allgather_*``) so the ``DELPHI_FAULT_PLAN`` chaos
+harness can target it; the raw ``multihost_utils.process_allgather``
+transport appears ONLY inside the ``_gather`` thunks here (a static guard
+in tests/test_transfer_guard.py enforces that)."""
 
 import os
 
@@ -30,7 +39,11 @@ def maybe_initialize_distributed() -> bool:
     """Idempotently joins the multi-host cluster when DELPHI_COORDINATOR is
     set. Must run before the first backend touch (jax.devices()); callers
     in this package invoke it from mesh construction and the batch entry
-    point. Returns True when running multi-host."""
+    point. Returns True when running multi-host. After a successful join
+    the distributed resilience plane starts the local liveness toucher
+    and runs the first membership heartbeat, so a peer that wedges during
+    startup is detected here — bounded — rather than at the first real
+    collective."""
     global _initialized
     coordinator = os.environ.get("DELPHI_COORDINATOR", "")
     if not coordinator:
@@ -39,6 +52,19 @@ def maybe_initialize_distributed() -> bool:
         return True
 
     import jax
+
+    # CPU-backed clusters (localhost benches, the dist-chaos A/B, CI) need
+    # an explicit cross-process collectives implementation: without one,
+    # every process_allgather dies with "Multiprocess computations aren't
+    # implemented on the CPU backend". Must land before the CPU client is
+    # created; a no-op for TPU-backed runs.
+    try:
+        platforms = str(jax.config.jax_platforms or
+                        os.environ.get("JAX_PLATFORMS", ""))
+        if "cpu" in platforms:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - older jaxlib without gloo
+        pass
 
     kwargs = {"coordinator_address": coordinator}
     num = os.environ.get("DELPHI_NUM_PROCESSES", "")
@@ -52,6 +78,9 @@ def maybe_initialize_distributed() -> bool:
     _logger.info(
         f"jax.distributed initialized: process {jax.process_index()} of "
         f"{jax.process_count()}, {len(jax.devices())} global devices")
+    from delphi_tpu.parallel import dist_resilience
+    dist_resilience.start_liveness()
+    dist_resilience.ensure_membership()
     return True
 
 
@@ -72,38 +101,51 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def allgather_host_bytes(payload: bytes) -> list:
+def allgather_host_bytes(payload: bytes,
+                         site: str = "dist.allgather_bytes") -> list:
     """All-gathers one opaque byte string per process (vocab unification for
     sharded ingestion). Two rounds over the device collective: lengths first,
     then the max-padded payloads — the multi-host analog of the driver
-    collecting every executor's dictionary."""
+    collecting every executor's dictionary. Degraded (peer lost): returns
+    only this process's payload."""
     import numpy as np
-    from jax.experimental import multihost_utils
 
     if process_count() == 1:
         return [payload]
-    length = np.asarray([len(payload)], dtype=np.int32)
-    lengths = np.asarray(
-        multihost_utils.process_allgather(length)).reshape(-1)
-    max_len = int(lengths.max())
-    padded = np.zeros(max_len, dtype=np.uint8)
-    padded[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    return [gathered[i, :int(lengths[i])].tobytes()
-            for i in range(len(lengths))]
+    from delphi_tpu.parallel.dist_resilience import guarded_collective
 
+    def _gather():
+        from jax.experimental import multihost_utils
+        length = np.asarray([len(payload)], dtype=np.int32)
+        lengths = np.asarray(
+            multihost_utils.process_allgather(length)).reshape(-1)
+        max_len = int(lengths.max())
+        padded = np.zeros(max_len, dtype=np.uint8)
+        padded[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        return [gathered[i, :int(lengths[i])].tobytes()
+                for i in range(len(lengths))]
+
+    return guarded_collective(site, _gather, fallback=lambda: [payload])
 
 
 def allgather_sum(arr):
     """Elementwise sum of a small numeric array across processes (global
-    counts from per-shard counts). Identity when single-process."""
+    counts from per-shard counts). Identity when single-process or after
+    a rank-loss degrade (the local shard's counts stand alone)."""
     import numpy as np
 
     arr = np.asarray(arr)
     if process_count() == 1:
         return arr
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr)).sum(axis=0)
+    from delphi_tpu.parallel.dist_resilience import guarded_collective
+
+    def _gather():
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr)).sum(axis=0)
+
+    return guarded_collective("dist.allgather_sum", _gather,
+                              fallback=lambda: arr)
 
 
 def allgather_any(mask):
@@ -114,9 +156,15 @@ def allgather_any(mask):
     mask = np.asarray(mask, dtype=bool)
     if process_count() == 1:
         return mask
-    from jax.experimental import multihost_utils
-    return np.asarray(
-        multihost_utils.process_allgather(mask)).any(axis=0)
+    from delphi_tpu.parallel.dist_resilience import guarded_collective
+
+    def _gather():
+        from jax.experimental import multihost_utils
+        return np.asarray(
+            multihost_utils.process_allgather(mask)).any(axis=0)
+
+    return guarded_collective("dist.allgather_any", _gather,
+                              fallback=lambda: mask)
 
 
 def allgather_max(arr):
@@ -126,15 +174,23 @@ def allgather_max(arr):
     arr = np.asarray(arr)
     if process_count() == 1:
         return arr
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr)).max(axis=0)
+    from delphi_tpu.parallel.dist_resilience import guarded_collective
+
+    def _gather():
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr)).max(axis=0)
+
+    return guarded_collective("dist.allgather_max", _gather,
+                              fallback=lambda: arr)
 
 
-def allgather_pickled(obj) -> list:
+def allgather_pickled(obj, site: str = "dist.allgather_bytes") -> list:
     """All-gathers one picklable object per process (training-sample frames
     and trained models in the process-local pipeline). Returns the P
-    objects in process order on every process."""
+    objects in process order on every process; just ``[obj]`` after a
+    rank-loss degrade. ``site`` lets high-level callers label their seam
+    (the report aggregation passes ``report.gather``)."""
     import pickle
 
     return [pickle.loads(b)
-            for b in allgather_host_bytes(pickle.dumps(obj))]
+            for b in allgather_host_bytes(pickle.dumps(obj), site=site)]

@@ -27,6 +27,54 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
 
 _active_mesh_cache: dict = {}
 
+
+def mesh_is_multiprocess(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` spans devices owned by other processes — the
+    question every sharding helper actually asks (``jax.process_count()``
+    answers a different one: after a rank-loss degrade the CLUSTER is
+    still multi-process while the active mesh has shrunk to local
+    devices, and cross-process placement paths must not be taken)."""
+    if mesh is None:
+        return False
+    try:
+        me = jax.process_index()
+        return any(d.process_index != me for d in mesh.devices.flat)
+    except Exception:  # pragma: no cover - backend specific
+        return jax.process_count() > 1
+
+
+def mesh_process_count(mesh: Optional[Mesh]) -> int:
+    """Number of distinct processes contributing devices to ``mesh``."""
+    if mesh is None:
+        return 1
+    try:
+        return len({d.process_index for d in mesh.devices.flat})
+    except Exception:  # pragma: no cover - backend specific
+        return int(jax.process_count())
+
+
+def _maybe_shrunk(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Elastic re-shard after a rank loss: once the distributed
+    resilience plane latches single-host execution, every phase re-enters
+    on a mesh over THIS process's devices only — same axis layout,
+    cluster peers excluded — so the surviving rank keeps computing
+    instead of wedging in psums that can never complete."""
+    if mesh is None:
+        return None
+    from delphi_tpu.parallel import dist_resilience
+    if not dist_resilience.single_host_latched() \
+            or not mesh_is_multiprocess(mesh):
+        return mesh
+    key = "__shrunk__"
+    if key not in _active_mesh_cache:
+        me = jax.process_index()
+        local = [d for d in mesh.devices.flat if d.process_index == me]
+        axis = mesh.axis_names[0] if mesh.axis_names else "dp"
+        _active_mesh_cache[key] = (
+            Mesh(np.asarray(local), (axis,)) if local else None)
+        dist_resilience.note_mesh_shrunk()
+    return _active_mesh_cache[key]
+
 # After this many consecutive failed backend probes, stop re-probing on
 # every stats op and only retry after a cool-down — a recovered backend
 # (e.g. a TPU tunnel coming back) is still picked up at the next window.
@@ -97,7 +145,7 @@ def get_active_mesh() -> Optional[Mesh]:
             _active_mesh_cache.pop("__probe_failures__", None)
             _active_mesh_cache.pop("__probe_retry_at__", None)
             _active_mesh_cache["__default__"] = mesh
-        return _active_mesh_cache["__default__"]
+        return _maybe_shrunk(_active_mesh_cache["__default__"])
     if setting in ("0", "off", "none"):
         return None
     if setting != "auto" and not setting.isdigit():
@@ -117,7 +165,7 @@ def get_active_mesh() -> Optional[Mesh]:
         else:
             _active_mesh_cache[key] = make_mesh(
                 min(n_devices, available) if n_devices else None)
-    return _active_mesh_cache[key]
+    return _maybe_shrunk(_active_mesh_cache[key])
 
 
 def _default_mesh() -> Tuple[Optional[Mesh], bool]:
@@ -195,7 +243,7 @@ def shard_rows(array: np.ndarray, mesh: Mesh, axis: str = "dp"):
     # host->device upload and must show up in the same accounting
     from delphi_tpu.ops.xfer import record_transfer
     record_transfer(array.nbytes)
-    if jax.process_count() > 1:
+    if mesh_is_multiprocess(mesh):
         return jax.make_array_from_callback(
             array.shape, sharding,
             lambda idx: np.ascontiguousarray(array[idx]))
@@ -212,14 +260,15 @@ def shard_rows_process_local(local_rows: np.ndarray, mesh: Mesh,
     process-major. Padding rows carry `fill` (-2 = the stats kernels'
     scratch slot)."""
     import jax
-    from jax.experimental import multihost_utils
 
     n_local = local_rows.shape[0]
     ld = max(1, int(mesh.local_mesh.shape[axis]))
-    if jax.process_count() > 1:
-        counts = np.asarray(multihost_utils.process_allgather(
-            np.asarray([n_local], dtype=np.int64))).reshape(-1)
-        per = int(counts.max())
+    if mesh_is_multiprocess(mesh):
+        # bounded collective (dist.allgather_max): a dead peer degrades
+        # this to the local count instead of hanging the ingestion
+        from delphi_tpu.parallel.distributed import allgather_max
+        per = int(allgather_max(
+            np.asarray([n_local], dtype=np.int64))[0])
     else:
         per = n_local
     per = ((max(per, 1) + ld - 1) // ld) * ld
@@ -228,7 +277,7 @@ def shard_rows_process_local(local_rows: np.ndarray, mesh: Mesh,
     padded = np.concatenate([local_rows, pad], axis=0)
     spec = P(axis, *([None] * (local_rows.ndim - 1)))
     sharding = NamedSharding(mesh, spec)
-    global_shape = (per * jax.process_count(),) + local_rows.shape[1:]
+    global_shape = (per * mesh_process_count(mesh),) + local_rows.shape[1:]
     from delphi_tpu.ops.xfer import record_transfer
     record_transfer(padded.nbytes)  # this process's contributed block
     return jax.make_array_from_process_local_data(sharding, padded, global_shape)

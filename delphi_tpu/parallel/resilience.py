@@ -34,7 +34,13 @@ allowed; attempts count, so ``site:1:oom,site:2:oom`` survives a retry
 budget of one). Each triple fires exactly once and the injected exception
 carries a realistic message so the REAL classifier path is exercised. The
 extra kind ``fatal`` injects an unclassifiable error (test harness for
-crash/resume).
+crash/resume). A leading rank pattern (``rank:site:nth:kind``, fnmatch
+over the process index) scopes an entry to specific ranks, and the
+special kinds ``stall`` (wedge the calling thread forever) and
+``rank_death`` (``os._exit(17)``) drive the multi-process chaos runs —
+the cross-rank half of the plane lives in
+:mod:`~delphi_tpu.parallel.dist_resilience` (``guarded_collective``,
+rank heartbeats, ``rank_loss`` degrade).
 
 **Phase checkpoints** (``DELPHI_CHECKPOINT_DIR`` / ``repair.checkpoint.dir``):
 :class:`PhaseCheckpointStore` persists fingerprinted per-phase outputs
@@ -98,13 +104,22 @@ KIND_OOM = "oom"
 KIND_TRANSFER = "transfer"
 KIND_COMPILE = "compile"
 KIND_TRANSIENT = "transient"
+KIND_RANK_LOSS = "rank_loss"
 FAULT_KINDS = (KIND_INIT_TIMEOUT, KIND_OOM, KIND_TRANSFER, KIND_COMPILE,
-               KIND_TRANSIENT)
+               KIND_TRANSIENT, KIND_RANK_LOSS)
 
 
 class BackendInitTimeout(RuntimeError):
     """The backend-init probe hit its hard deadline (the hanging-TPU-init
     failure mode): raised instead of stalling the run forever."""
+
+
+class RankLost(RuntimeError):
+    """A cross-rank interaction (host collective, heartbeat) timed out or
+    failed because a peer rank is dead or wedged. Raised by
+    :func:`~delphi_tpu.parallel.dist_resilience.guarded_collective` only
+    when the call site supplied no local fallback; classified as
+    :data:`KIND_RANK_LOSS`."""
 
 
 class FaultInjected(BaseException):
@@ -162,8 +177,17 @@ _INJECT_MESSAGES = {
                    "(injected at {site} call {n})"),
     KIND_TRANSIENT: ("UNAVAILABLE: connection to coordination service "
                      "lost (injected at {site} call {n})"),
+    KIND_RANK_LOSS: ("DEADLINE_EXCEEDED: collective operation timed out "
+                     "waiting for remote ranks (injected at {site} "
+                     "call {n})"),
     "fatal": "injected unclassifiable fault at {site} call {n}",
 }
+
+#: Plan kinds that do not raise: ``stall`` wedges the calling thread
+#: forever (a real wedge, exercised by the peers' collective watchdogs),
+#: ``rank_death`` hard-exits the process (``os._exit(17)``) — the two
+#: dist-chaos failure modes a 2-process A/B injects deterministically.
+SPECIAL_INJECT_KINDS = frozenset({"stall", "rank_death"})
 
 # Case-sensitive gRPC/XLA status codes; lower-case word patterns matched
 # case-insensitively below. Order matters: the first matching kind wins, and
@@ -180,6 +204,14 @@ _WORD_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = (
         r"backend.{0,40}init\w*.{0,40}(timed out|timeout|deadline)"
         r"|init\w*.{0,40}(timed out|deadline exceeded)"
         r"|deadline_exceeded.{0,60}init", re.IGNORECASE | re.DOTALL)),
+    (KIND_RANK_LOSS, re.compile(
+        r"collective.{0,60}(timed out|timeout|deadline)"
+        r"|(rank|peer|process \d+).{0,40}"
+        r"(lost|died|unreachable|disconnected|terminated)"
+        r"|heartbeat.{0,40}(missed|stale|timed out)"
+        r"|barrier.{0,40}(timed out|timeout)"
+        r"|shutting down.{0,40}coordination service",
+        re.IGNORECASE | re.DOTALL)),
     (KIND_OOM, re.compile(
         r"out of memory|\boom\b|exhausted|failed to allocate"
         r"|allocation.{0,30}(failed|exceed)|hbm.{0,30}exceed",
@@ -206,14 +238,19 @@ def classify_fault(exc: BaseException) -> Optional[str]:
         return None
     if isinstance(exc, BackendInitTimeout):
         return KIND_INIT_TIMEOUT
+    if isinstance(exc, RankLost):
+        return KIND_RANK_LOSS
     msg = f"{type(exc).__name__}: {exc}"
-    for kind, pat in _WORD_PATTERNS[:1]:  # init_timeout outranks the codes
+    # init_timeout and rank_loss outrank the codes: both typically arrive
+    # spelled DEADLINE_EXCEEDED/UNAVAILABLE, and the generic transient
+    # match must not swallow them
+    for kind, pat in _WORD_PATTERNS[:2]:
         if pat.search(msg):
             return kind
     for kind, pat in _CODE_PATTERNS:
         if pat.search(msg):
             return kind
-    for kind, pat in _WORD_PATTERNS[1:]:
+    for kind, pat in _WORD_PATTERNS[2:]:
         if pat.search(msg):
             return kind
     return None
@@ -292,34 +329,88 @@ KNOWN_SITES = frozenset({
     "gbdt.cv_chunk",
     "gbdt.fit_chunk",
     "escalate.joint",
+    "dist.heartbeat",
+    "dist.allgather_bytes",
+    "dist.allgather_sum",
+    "dist.allgather_any",
+    "dist.allgather_max",
+    "report.gather",
 })
 
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")
+_PLAN_RANK_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*([^:\s]+)\s*:"
+                           r"\s*(\d+)\s*:\s*([a-z_]+)\s*$")
+
+_PLAN_KINDS = frozenset(FAULT_KINDS) | {"fatal"} | SPECIAL_INJECT_KINDS
 
 
 def parse_fault_plan(text: str):
-    """``site:nth:kind`` triples, comma-separated. ``site`` is an fnmatch
-    pattern over guarded-seam site names; ``nth`` is the 1-based seam-entry
-    count for that site (attempts count, so consecutive ``nth`` values hit
-    consecutive retries); ``kind`` is a taxonomy kind or ``fatal``."""
+    """``site:nth:kind`` triples — or rank-scoped ``rank:site:nth:kind``
+    quadruples — comma-separated. ``site`` is an fnmatch pattern over
+    guarded-seam site names; ``nth`` is the 1-based seam-entry count for
+    that site (attempts count, so consecutive ``nth`` values hit
+    consecutive retries); ``kind`` is a taxonomy kind, ``fatal``, or one
+    of :data:`SPECIAL_INJECT_KINDS`. The optional leading ``rank`` is an
+    fnmatch pattern over the process index, so one shared plan text
+    drives a reproducible multi-process chaos run (non-matching ranks
+    still count the seam entry, they just never fire the entry). Legacy
+    3-field entries parse to 3-tuples unchanged; rank-scoped entries
+    carry the rank pattern as a 4th element."""
     triples = []
     for part in text.split(","):
         if not part.strip():
             continue
         m = _PLAN_RE.match(part)
-        if not m:
-            raise ValueError(
-                f"DELPHI_FAULT_PLAN: bad triple {part!r} "
-                "(expected site:nth:kind)")
-        pat, nth, kind = m.group(1), int(m.group(2)), m.group(3)
-        if kind not in FAULT_KINDS and kind != "fatal":
+        rank_pat = None
+        if m is None:
+            m4 = _PLAN_RANK_RE.match(part)
+            if not m4:
+                raise ValueError(
+                    f"DELPHI_FAULT_PLAN: bad triple {part!r} "
+                    "(expected site:nth:kind or rank:site:nth:kind)")
+            rank_pat, pat, nth, kind = (m4.group(1), m4.group(2),
+                                        int(m4.group(3)), m4.group(4))
+        else:
+            pat, nth, kind = m.group(1), int(m.group(2)), m.group(3)
+        if kind not in _PLAN_KINDS:
             raise ValueError(
                 f"DELPHI_FAULT_PLAN: unknown fault kind {kind!r} "
-                f"(one of {', '.join(FAULT_KINDS)}, fatal)")
+                f"(one of {', '.join(FAULT_KINDS)}, fatal, "
+                f"{', '.join(sorted(SPECIAL_INJECT_KINDS))})")
         if nth < 1:
             raise ValueError("DELPHI_FAULT_PLAN: nth is 1-based")
-        triples.append((pat, nth, kind))
+        triples.append((pat, nth, kind) if rank_pat is None
+                       else (pat, nth, kind, rank_pat))
     return tuple(triples)
+
+
+def _injection_rank() -> str:
+    """The process index the rank-scoped plan entries match against.
+    ``DELPHI_PROCESS_ID`` (the launcher's spelling) wins so light tests
+    and pre-init code never have to touch the jax backend."""
+    env = os.environ.get("DELPHI_PROCESS_ID", "")
+    if env.strip().isdigit():
+        return env.strip()
+    try:
+        from delphi_tpu.parallel import distributed
+        return str(distributed.process_index())
+    except Exception:
+        return "0"
+
+
+def _entry_hit(entry, site: str, n: int, rank_text: Optional[str]):
+    """The kind to fire when plan ``entry`` matches this (site, entry
+    count) on this rank, else None. ``rank_text`` is resolved lazily by
+    the caller (only when the plan has rank-scoped entries at all)."""
+    pat, nth, kind = entry[0], entry[1], entry[2]
+    if nth != n or not fnmatch.fnmatchcase(site, pat):
+        return None
+    if len(entry) > 3 and entry[3] is not None:
+        if not fnmatch.fnmatchcase(
+                rank_text if rank_text is not None else _injection_rank(),
+                entry[3]):
+            return None
+    return kind
 
 
 def _fault_plan_text() -> str:
@@ -346,8 +437,9 @@ def validate_fault_plan(triples: Sequence[Tuple[str, int, str]],
     unmatched pattern, so a typo'd chaos plan is loud instead of a
     false-green A/B run."""
     unmatched = tuple(sorted(
-        {pat for pat, _nth, _kind in triples
-         if not any(fnmatch.fnmatchcase(s, pat) for s in KNOWN_SITES)}))
+        {entry[0] for entry in triples
+         if not any(fnmatch.fnmatchcase(s, entry[0])
+                    for s in KNOWN_SITES)}))
     if unmatched:
         key = (source, unmatched)
         with _plan_lock:
@@ -373,6 +465,27 @@ def reset_fault_state() -> None:
         _validated_plans.clear()
 
 
+def _stall_forever() -> None:
+    """Wedges the calling thread forever — the injected ``stall`` fault.
+    Module-level seam so unit tests can monkeypatch it into a no-op
+    while the dist-chaos subprocess workers really do wedge."""
+    threading.Event().wait()
+
+
+def _fire_injection(kind: str, site: str, n: int, source: str) -> None:
+    """Fires one matched plan entry: the special kinds act (wedge / die)
+    instead of raising, everything else raises :class:`FaultInjected`
+    with a realistic message for the classifier."""
+    counter_inc("resilience.injected")
+    _logger.warning(f"{source}: injecting {kind} at {site} (call {n})")
+    if kind == "stall":
+        _stall_forever()
+        return
+    if kind == "rank_death":
+        os._exit(17)
+    raise FaultInjected(kind, site, n)
+
+
 def _maybe_inject(site: str) -> None:
     scope = current_scope()
     if scope is not None:
@@ -393,21 +506,21 @@ def _maybe_inject(site: str) -> None:
             return
         n = _plan_state["calls"].get(site, 0) + 1
         _plan_state["calls"][site] = n
+        rank_text = _injection_rank() \
+            if any(len(t) > 3 for t in triples) else None
         hit = None
-        for i, (pat, nth, kind) in enumerate(triples):
+        for i, entry in enumerate(triples):
             if i in _plan_state["fired"]:
                 continue
-            if nth == n and fnmatch.fnmatchcase(site, pat):
+            kind = _entry_hit(entry, site, n, rank_text)
+            if kind is not None:
                 _plan_state["fired"].add(i)
                 hit = (kind, n)
                 break
     if armed:
         validate_fault_plan(armed)
     if hit is not None:
-        counter_inc("resilience.injected")
-        _logger.warning(f"fault plan: injecting {hit[0]} at {site} "
-                        f"(call {hit[1]})")
-        raise FaultInjected(hit[0], site, hit[1])
+        _fire_injection(hit[0], site, hit[1], "fault plan")
 
 
 # -- request scopes (per-session isolation for the serving plane) ------------
@@ -473,20 +586,20 @@ class RequestScope:
         with self._lock:
             n = self._calls.get(site, 0) + 1
             self._calls[site] = n
+            rank_text = _injection_rank() \
+                if any(len(t) > 3 for t in self.plan_triples) else None
             hit = None
-            for i, (pat, nth, kind) in enumerate(self.plan_triples):
+            for i, entry in enumerate(self.plan_triples):
                 if i in self._fired:
                     continue
-                if nth == n and fnmatch.fnmatchcase(site, pat):
+                kind = _entry_hit(entry, site, n, rank_text)
+                if kind is not None:
                     self._fired.add(i)
                     hit = (kind, n)
                     break
         if hit is not None:
-            counter_inc("resilience.injected")
-            _logger.warning(
-                f"request {self.request_id} fault plan: injecting {hit[0]} "
-                f"at {site} (call {hit[1]})")
-            raise FaultInjected(hit[0], site, hit[1])
+            _fire_injection(hit[0], site, hit[1],
+                            f"request {self.request_id} fault plan")
 
 
 def current_scope() -> Optional[RequestScope]:

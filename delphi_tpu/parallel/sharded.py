@@ -14,7 +14,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from delphi_tpu.parallel.mesh import (
-    pad_rows_to_multiple, shard_map, shard_map_unchecked, shard_rows)
+    mesh_is_multiprocess, pad_rows_to_multiple, shard_map,
+    shard_map_unchecked, shard_rows)
 
 
 def sharded_single_counts(codes: np.ndarray, v_pad: int, mesh: Mesh) -> np.ndarray:
@@ -113,8 +114,11 @@ def sharded_domain_scores(codes_chunk: Sequence[np.ndarray],
     # Multi-host: a row-sharded output spans processes and cannot be read
     # back by any single host, so the per-cell scores all-gather to every
     # device (same transient size the single-host path materializes anyway;
-    # the chunked caller bounds `cells`).
-    multihost = jax.process_count() > 1
+    # the chunked caller bounds `cells`). Keyed off the MESH, not the
+    # cluster: after a rank-loss degrade the cluster is still
+    # multi-process but the shrunk mesh is local and the single-host
+    # readback path is the correct one.
+    multihost = mesh_is_multiprocess(mesh)
     out_shard = P() if multihost else P("dp", None)
 
     smap = shard_map_unchecked if multihost else shard_map

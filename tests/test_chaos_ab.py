@@ -9,8 +9,15 @@
   fails with a structured error, the clean request stays bit-identical
   to a solo run, and a follow-up request reuses the warm compile cache
   (compile_cache.hits > 0) and table fingerprint cache.
+- ``bench.dist_chaos_smoke``: the distributed resilience A/B — a
+  2-process localhost CPU cluster under rank-scoped fault plans (rank 1
+  stalls inside the report-gather collective; rank 1 dies at a
+  heartbeat); rank 0 must degrade through the guarded-collective
+  deadline (rank_loss, single-host latch, per-rank report flagged
+  ``aggregation_incomplete``) and still produce a frame bit-identical
+  to a clean single-process run.
 
-Both functions print one JSON metric line and return 0 on success; they
+All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
 """
 
@@ -19,6 +26,7 @@ import os
 import pytest
 
 import bench
+from delphi_tpu.parallel import dist_resilience as dr
 from delphi_tpu.parallel import resilience as rz
 
 
@@ -27,10 +35,13 @@ def _clean_chaos_state():
     saved = {v: os.environ.get(v) for v in
              ("DELPHI_FAULT_PLAN", "DELPHI_DOMAIN_DEVICE",
               "DELPHI_RETRY_BASE_S", "DELPHI_COMPILE_CACHE_MIN_S",
-              "DELPHI_COMPILE_CACHE_DIR")}
+              "DELPHI_COMPILE_CACHE_DIR", "DELPHI_MESH",
+              "DELPHI_COLLECTIVE_TIMEOUT_S", "DELPHI_HEARTBEAT_S",
+              "DELPHI_LIVENESS_DIR", "DELPHI_CHECKPOINT_DIR")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
+    dr.reset_dist_state()
     yield
     for v, old in saved.items():
         if old is None:
@@ -40,6 +51,7 @@ def _clean_chaos_state():
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
+    dr.reset_dist_state()
 
 
 def test_chaos_smoke_ab_bit_identical():
@@ -48,3 +60,7 @@ def test_chaos_smoke_ab_bit_identical():
 
 def test_serve_chaos_concurrent_isolation():
     assert bench.serve_chaos_smoke(bench._smoke_frame()) == 0
+
+
+def test_dist_chaos_survivor_bit_identical():
+    assert bench.dist_chaos_smoke() == 0

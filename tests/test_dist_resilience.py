@@ -1,0 +1,410 @@
+"""Distributed resilience plane (parallel/dist_resilience.py): the guarded
+collective seam, rank heartbeat/membership, liveness diagnosis, coordinated
+single-host degrade, and the rank-scoped fault-plan grammar — all against
+faked 2-process topologies (monkeypatched ``process_count``/
+``process_index`` seams) and fake clocks/waits, no cluster spawned."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from delphi_tpu import observability as obs
+from delphi_tpu.parallel import dist_resilience as dr
+from delphi_tpu.parallel import distributed as dist
+from delphi_tpu.parallel import resilience as rz
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    rz.reset_fault_state()
+    dr.reset_dist_state()
+    yield
+    rz.reset_fault_state()
+    dr.reset_dist_state()
+
+
+def _fake_two_ranks(monkeypatch, me: int = 0):
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "process_index", lambda: me)
+
+
+# -- guarded_collective ------------------------------------------------------
+
+
+def test_single_process_runs_inline():
+    calls = []
+    out = dr.guarded_collective("dist.allgather_sum",
+                                lambda: calls.append(1) or "v")
+    assert out == "v" and calls == [1]
+    assert not dr.single_host_latched()
+    assert dr.report_section() is None
+
+
+def test_timeout_declares_rank_loss_and_degrades(monkeypatch, tmp_path):
+    """Deadline expiry: classify as rank_loss, count every transition,
+    write the checkpoint marker, latch single-host, return the fallback."""
+    _fake_two_ranks(monkeypatch)
+    monkeypatch.setenv("DELPHI_CHECKPOINT_DIR", str(tmp_path))
+    # force the watchdog wait to report expiry without sleeping
+    monkeypatch.setattr(dr, "_wait", lambda event, timeout_s: False)
+
+    rec = obs.start_recording("dist.timeout")
+    try:
+        out = dr.guarded_collective("dist.allgather_sum", lambda: "remote",
+                                    fallback=lambda: "local")
+    finally:
+        obs.stop_recording(rec)
+    assert out == "local"
+    assert dr.single_host_latched()
+    assert dr.degraded_ranks() == [1]
+
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["resilience.dist.collective_timeouts"] == 1
+    assert counters["resilience.dist.rank_loss"] == 1
+    assert counters["resilience.dist.single_host_latch"] == 1
+    assert counters["resilience.faults.rank_loss"] == 1
+
+    marker = json.loads((tmp_path / "rank_loss.json").read_text())
+    assert marker["site"] == "dist.allgather_sum"
+    assert marker["lost_ranks"] == [1]
+    assert marker["surviving_rank"] == 0
+
+    section = dr.report_section()
+    assert section["single_host_latched"] is True
+    assert section["degraded_ranks"] == [1]
+    assert section["latch_site"] == "dist.allgather_sum"
+
+
+def test_timeout_without_fallback_raises_rank_lost(monkeypatch):
+    _fake_two_ranks(monkeypatch)
+    monkeypatch.setattr(dr, "_wait", lambda event, timeout_s: False)
+    with pytest.raises(rz.RankLost):
+        dr.guarded_collective("dist.allgather_sum", lambda: "remote")
+    assert dr.single_host_latched()
+
+
+def test_latched_collective_short_circuits(monkeypatch):
+    """After the latch no collective is entered again (the peers are gone
+    — entering would hang): fallback returned, thunk never called."""
+    _fake_two_ranks(monkeypatch)
+    dr.declare_rank_lost("dist.allgather_sum", reason="test latch")
+
+    def thunk():
+        raise AssertionError("latched collective must not run")
+
+    assert dr.guarded_collective("dist.allgather_max", thunk,
+                                 fallback=lambda: "local") == "local"
+    with pytest.raises(rz.RankLost):
+        dr.guarded_collective("dist.allgather_max", thunk)
+
+
+def test_classified_collective_error_degrades(monkeypatch):
+    """A cross-rank failure raised BY the collective (not a timeout)
+    classifies through the standard taxonomy and degrades immediately —
+    collectives are never retried."""
+    _fake_two_ranks(monkeypatch)
+
+    def thunk():
+        raise RuntimeError(
+            "DEADLINE_EXCEEDED: barrier timed out; process 1 disconnected")
+
+    rec = obs.start_recording("dist.error")
+    try:
+        out = dr.guarded_collective("dist.allgather_any", thunk,
+                                    fallback=lambda: "local")
+    finally:
+        obs.stop_recording(rec)
+    assert out == "local"
+    assert dr.single_host_latched()
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["resilience.dist.rank_loss"] == 1
+    assert counters["resilience.faults.rank_loss"] >= 1
+
+
+def test_unclassified_collective_error_stays_loud(monkeypatch):
+    _fake_two_ranks(monkeypatch)
+
+    def thunk():
+        raise ValueError("plain programming bug")
+
+    with pytest.raises(ValueError, match="plain programming bug"):
+        dr.guarded_collective("dist.allgather_any", thunk,
+                              fallback=lambda: "local")
+    assert not dr.single_host_latched()
+
+
+def test_injected_rank_loss_fires_on_caller(monkeypatch):
+    """A DELPHI_FAULT_PLAN rank_loss entry at a collective site degrades
+    without the thunk ever running (the injection seam fires before the
+    watchdog thread starts)."""
+    _fake_two_ranks(monkeypatch)
+    monkeypatch.setenv("DELPHI_FAULT_PLAN", "dist.allgather_sum:1:rank_loss")
+    rz.reset_fault_state()
+
+    def thunk():
+        raise AssertionError("injected collective must not run")
+
+    out = dr.guarded_collective("dist.allgather_sum", thunk,
+                                fallback=lambda: "local")
+    assert out == "local"
+    assert dr.single_host_latched()
+
+
+def test_zero_timeout_disables_watchdog(monkeypatch):
+    _fake_two_ranks(monkeypatch)
+
+    def boom(event, timeout_s):
+        raise AssertionError("watchdog must be off at timeout 0")
+
+    monkeypatch.setattr(dr, "_wait", boom)
+    out = dr.guarded_collective("dist.allgather_sum", lambda: "inline",
+                                fallback=lambda: "local", timeout_s=0)
+    assert out == "inline"
+
+
+# -- heartbeat / membership --------------------------------------------------
+
+
+def test_ensure_membership_faked_two_process(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    _fake_two_ranks(monkeypatch)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.stack([np.asarray([0], dtype=np.int32),
+                              np.asarray([1], dtype=np.int32)]))
+    rec = obs.start_recording("dist.membership")
+    try:
+        alive = dr.ensure_membership()
+        # snapshot before stop_recording (whose aggregation path runs a
+        # second heartbeat on this faked 2-rank topology)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert alive == [0, 1]
+    assert counters["resilience.dist.heartbeats"] == 1
+    section = dr.report_section()
+    assert section["alive_ranks"] == [0, 1]
+    assert section["expected_ranks"] == 2
+    assert section["degraded_ranks"] == []
+
+
+def test_ensure_membership_timeout_degrades(monkeypatch):
+    """The heartbeat itself rides the guarded seam: expiry follows the
+    standard timeout -> rank_loss -> latch path and returns just this
+    rank (the elastic shrunk-membership re-entry)."""
+    _fake_two_ranks(monkeypatch)
+    monkeypatch.setattr(dr, "_wait", lambda event, timeout_s: False)
+    rec = obs.start_recording("dist.hb_timeout")
+    try:
+        alive = dr.ensure_membership()
+    finally:
+        obs.stop_recording(rec)
+    assert alive == [0]
+    assert dr.single_host_latched()
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["resilience.dist.rank_loss"] == 1
+    assert counters["resilience.dist.heartbeats"] == 1
+    assert dr.report_section()["latch_site"] == "dist.heartbeat"
+
+
+def test_liveness_diagnosis_fake_clock(monkeypatch, tmp_path):
+    """Liveness files carry the wall clock as CONTENT (not mtime): a peer
+    whose stamp went stale diagnoses as dead, a fresh one as stalled, a
+    missing one as unknown — all driven by a fake clock."""
+    monkeypatch.setenv("DELPHI_LIVENESS_DIR", str(tmp_path))
+    monkeypatch.setenv("DELPHI_HEARTBEAT_S", "10")
+    monkeypatch.setattr(dr, "_wall", lambda: 1000.0)
+
+    _fake_two_ranks(monkeypatch, me=1)
+    dr.touch_liveness()  # rank 1 stamps t=1000
+
+    _fake_two_ranks(monkeypatch, me=0)
+    assert dr.peer_liveness_age_s(1, now=1005.0) == pytest.approx(5.0)
+    assert dr.diagnose_peer(1, now=1010.0) == "stalled"   # 10s <= 3x10s
+    assert dr.diagnose_peer(1, now=1031.0) == "dead"      # 31s > 30s
+    assert dr.diagnose_peer(7) == "unknown"               # never stamped
+
+
+def test_declare_rank_lost_uses_liveness_diagnosis(monkeypatch, tmp_path):
+    monkeypatch.setenv("DELPHI_LIVENESS_DIR", str(tmp_path))
+    monkeypatch.setenv("DELPHI_HEARTBEAT_S", "10")
+    monkeypatch.setattr(dr, "_wall", lambda: 1000.0)
+    _fake_two_ranks(monkeypatch, me=1)
+    dr.touch_liveness()
+
+    _fake_two_ranks(monkeypatch, me=0)
+    monkeypatch.setattr(dr, "_wall", lambda: 1100.0)  # stamp is 100s stale
+    dr.declare_rank_lost("dist.allgather_sum", reason="test")
+    assert dr.report_section()["diagnosis"] == {"1": "dead"}
+
+
+# -- elastic mesh re-entry ---------------------------------------------------
+
+
+def test_latch_shrinks_active_mesh(monkeypatch):
+    """After the single-host latch, get_active_mesh's result re-enters on a
+    process-local mesh: same axis, cluster peers excluded, transition
+    counted once."""
+    from delphi_tpu.parallel import mesh as mesh_mod
+
+    full = mesh_mod.make_mesh(axis_names=("dp",))
+    # fake: the mesh "spans" another process (all devices here are local)
+    monkeypatch.setattr(mesh_mod, "mesh_is_multiprocess", lambda m: True)
+    mesh_mod._active_mesh_cache.pop("__shrunk__", None)
+    try:
+        assert mesh_mod._maybe_shrunk(full) is full  # healthy: untouched
+
+        _fake_two_ranks(monkeypatch)
+        dr.declare_rank_lost("dist.allgather_sum", reason="test")
+        rec = obs.start_recording("dist.shrink")
+        try:
+            shrunk = mesh_mod._maybe_shrunk(full)
+        finally:
+            obs.stop_recording(rec)
+        import jax
+        me = jax.process_index()
+        assert shrunk is not None and shrunk.axis_names == ("dp",)
+        assert all(d.process_index == me for d in shrunk.devices.flat)
+        counters = rec.registry.snapshot()["counters"]
+        assert counters["resilience.dist.mesh_shrunk"] == 1
+        assert dr.report_section()["mesh_shrunk"] is True
+        # cached: the second call returns the same mesh, no double count
+        assert mesh_mod._maybe_shrunk(full) is shrunk
+    finally:
+        mesh_mod._active_mesh_cache.pop("__shrunk__", None)
+
+
+# -- report aggregation degrade (stop_recording) -----------------------------
+
+
+def test_stop_recording_degrades_to_per_rank_report(monkeypatch):
+    """Satellite: with a peer already lost, stop_recording's aggregation
+    collective is skipped, the report keeps this rank's own view, and both
+    the counter and the dist section flag aggregation_incomplete."""
+    _fake_two_ranks(monkeypatch)
+    dr.declare_rank_lost("dist.allgather_sum", reason="test")
+
+    def boom(obj, site="report.gather"):
+        raise AssertionError("latched aggregation must not gather")
+
+    monkeypatch.setattr(dist, "allgather_pickled", boom)
+    rec = obs.start_recording("dist.agg")
+    rec.registry.inc("detect.cells_scanned", 7)
+    obs.stop_recording(rec)
+
+    assert rec.per_process is not None and len(rec.per_process) == 1
+    assert dr.aggregation_incomplete()
+    report = obs.build_run_report(rec, run={}, status="ok")
+    assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert report["dist"]["aggregation_incomplete"] is True
+    assert report["dist"]["degraded_ranks"] == [1]
+    # a degraded single-entry gather renders as a plain per-rank report:
+    # no per_process section, metrics from this rank's own registry
+    assert report["per_process"] is None
+    assert report["metrics"]["counters"]["detect.cells_scanned"] == 7
+
+
+def test_single_process_report_has_null_dist_section():
+    rec = obs.start_recording("dist.null")
+    obs.stop_recording(rec)
+    report = obs.build_run_report(rec, run={}, status="ok")
+    assert report["dist"] is None
+
+
+def test_v5_report_upgrades_with_null_dist(tmp_path):
+    v5 = {"schema_version": 5, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"},
+          "per_process": None, "scorecards": None, "drift": None,
+          "incremental": None, "escalation": None}
+    path = tmp_path / "v5.json"
+    path.write_text(json.dumps(v5))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert loaded["schema_version_loaded_from"] == 5
+    assert loaded["dist"] is None
+
+
+# -- rank-scoped fault plans -------------------------------------------------
+
+
+def test_parse_fault_plan_rank_scoped_grammar():
+    # legacy 3-field triples parse EXACTLY as before
+    assert list(rz.parse_fault_plan("a.b:1:oom")) == [("a.b", 1, "oom")]
+    # rank-scoped 4-field entries put the rank FIRST and parse to 4-tuples
+    assert list(rz.parse_fault_plan("1:dist.heartbeat:2:rank_death")) == \
+        [("dist.heartbeat", 2, "rank_death", "1")]
+    mixed = list(rz.parse_fault_plan(
+        "xfer.upload:1:transient, *:report.gather:1:stall"))
+    assert mixed == [("xfer.upload", 1, "transient"),
+                     ("report.gather", 1, "stall", "*")]
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        rz.parse_fault_plan("1:site:1:nonsense")
+    with pytest.raises(ValueError, match="1-based"):
+        rz.parse_fault_plan("1:site:0:oom")
+    with pytest.raises(ValueError, match="bad triple"):
+        rz.parse_fault_plan("too:many:fields:here:really")
+
+
+def test_rank_scoped_injection_matches_this_rank_only(monkeypatch):
+    """The rank field fnmatches against DELPHI_PROCESS_ID: entries scoped
+    to another rank never fire here, '*' fires everywhere."""
+    monkeypatch.setenv("DELPHI_PROCESS_ID", "1")
+    monkeypatch.setenv("DELPHI_FAULT_PLAN", "0:xfer.upload:1:oom")
+    rz.reset_fault_state()
+    rz._maybe_inject("xfer.upload")  # scoped to rank 0: silent on rank 1
+
+    monkeypatch.setenv("DELPHI_FAULT_PLAN", "*:xfer.upload:1:oom")
+    rz.reset_fault_state()
+    with pytest.raises(rz.FaultInjected):
+        rz._maybe_inject("xfer.upload")
+
+
+def test_stall_kind_wedges_via_seam(monkeypatch):
+    """The special ``stall`` kind wedges the caller thread through the
+    monkeypatchable _stall_forever seam (no exception raised) and then
+    lets the call proceed."""
+    stalled = []
+    monkeypatch.setattr(rz, "_stall_forever", lambda: stalled.append(True))
+    monkeypatch.setenv("DELPHI_PROCESS_ID", "0")
+    monkeypatch.setenv("DELPHI_FAULT_PLAN", "0:dist.allgather_sum:1:stall")
+    rz.reset_fault_state()
+    rz._maybe_inject("dist.allgather_sum")  # returns once the stall "ends"
+    assert stalled == [True]
+
+
+def test_rank_death_kind_exits_hard(monkeypatch):
+    """The special ``rank_death`` kind hard-exits (os._exit(17)) — verified
+    through a recording stub; a SystemExit stand-in stops the flow the way
+    the real call would."""
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        raise SystemExit(code)
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    monkeypatch.setenv("DELPHI_PROCESS_ID", "0")
+    monkeypatch.setenv("DELPHI_FAULT_PLAN", "*:dist.heartbeat:1:rank_death")
+    rz.reset_fault_state()
+    with pytest.raises(SystemExit):
+        rz._maybe_inject("dist.heartbeat")
+    assert codes == [17]
+
+
+def test_classify_rank_loss_wordings():
+    assert rz.classify_fault(rz.RankLost("x")) == rz.KIND_RANK_LOSS
+    for msg in (
+            "collective operation timed out waiting for remote ranks",
+            "process 1 was terminated by the coordinator",
+            "heartbeat missed for peer",
+            "barrier timed out at stop_recording",
+            "shutting down the coordination service"):
+        assert rz.classify_fault(RuntimeError(msg)) == rz.KIND_RANK_LOSS, msg
+    # the long-standing transient wording must NOT reclassify
+    assert rz.classify_fault(RuntimeError(
+        "UNAVAILABLE: connection to coordination service lost")) \
+        == "transient"

@@ -524,7 +524,7 @@ def test_report_merges_faked_two_process_run(monkeypatch):
         monkeypatch.setattr(dist, "process_count", lambda: 2)
         monkeypatch.setattr(dist, "process_index", lambda: 0)
         monkeypatch.setattr(dist, "allgather_pickled",
-                            lambda obj: [obj, obj])
+                            lambda obj, site=None: [obj, obj])
     finally:
         obs.stop_recording(recorder)
 

@@ -67,6 +67,7 @@ def test_shard_rows_uses_sharding_indices(monkeypatch):
     mesh covers a subset of processes; single-process path unchanged."""
     import jax
 
+    from delphi_tpu.parallel import mesh as mesh_mod
     from delphi_tpu.parallel.mesh import make_mesh, shard_rows
 
     mesh = make_mesh(4)
@@ -81,7 +82,10 @@ def test_shard_rows_uses_sharding_indices(monkeypatch):
             return block
         return real_cb(shape, sharding, wrapped)
 
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # the placement gate asks whether the MESH spans foreign processes
+    # (not jax.process_count — a shrunk post-rank-loss mesh is local even
+    # though the cluster is still multi-process)
+    monkeypatch.setattr(mesh_mod, "mesh_is_multiprocess", lambda m: True)
     monkeypatch.setattr(jax, "make_array_from_callback", spy)
     arr = shard_rows(data, mesh)
     np.testing.assert_array_equal(np.asarray(arr), data)

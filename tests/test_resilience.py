@@ -458,10 +458,16 @@ def test_known_sites_match_source_literals():
     import re
 
     root = pathlib.Path(rz.__file__).resolve().parents[1]
-    pat = re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"')
+    pats = (re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"'),
+            re.compile(r'guarded_collective\(\s*\n?\s*"([^"]+)"'),
+            # collective sites threaded as defaulted keywords
+            # (distributed.py's `site="dist.allgather_bytes"` idiom)
+            re.compile(r'site(?::\s*str)?\s*=\s*"([^"]+)"'))
     found = {"backend.init"}  # injected by probe_backend, not run_guarded
     for path in root.rglob("*.py"):
-        found.update(pat.findall(path.read_text()))
+        text = path.read_text()
+        for pat in pats:
+            found.update(pat.findall(text))
     assert found == set(rz.KNOWN_SITES), (
         f"KNOWN_SITES drift: source has {sorted(found)}, "
         f"registry has {sorted(rz.KNOWN_SITES)}")

@@ -89,19 +89,62 @@ def test_launch_modules_reference_the_resilience_seam():
 
 
 def test_guarded_site_names_are_registered():
-    """Every `run_guarded("<site>", ...)` literal in the source tree must be
-    a member of resilience.KNOWN_SITES — fault-plan validation (the one-time
-    "pattern matches no registered guarded site" warning at arm time) is
-    only trustworthy while the registry is complete. A new guarded seam
-    must register its site name."""
+    """Every `run_guarded("<site>", ...)` or `guarded_collective("<site>",
+    ...)` literal in the source tree must be a member of
+    resilience.KNOWN_SITES — fault-plan validation (the one-time "pattern
+    matches no registered guarded site" warning at arm time) is only
+    trustworthy while the registry is complete. A new guarded seam must
+    register its site name."""
     from delphi_tpu.parallel.resilience import KNOWN_SITES
 
     pkg_root = OPS_DIR.parent
-    pat = re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"')
+    pats = (re.compile(r'run_guarded\(\s*\n?\s*"([^"]+)"'),
+            re.compile(r'guarded_collective\(\s*\n?\s*"([^"]+)"'),
+            # collective sites threaded as defaulted keywords
+            # (distributed.py's `site="dist.allgather_bytes"` idiom)
+            re.compile(r'site(?::\s*str)?\s*=\s*"([^"]+)"'))
     found = set()
     for path in sorted(pkg_root.rglob("*.py")):
-        found.update(pat.findall(path.read_text()))
+        text = path.read_text()
+        for pat in pats:
+            found.update(pat.findall(text))
     unregistered = found - set(KNOWN_SITES)
     assert not unregistered, (
         f"run_guarded sites missing from resilience.KNOWN_SITES: "
         f"{sorted(unregistered)}")
+
+
+# the host-collective transport: raw process_allgather is legal ONLY inside
+# the `_gather` thunks of parallel/distributed.py and the membership
+# heartbeat in parallel/dist_resilience.py — everywhere else it would
+# bypass guarded_collective (no deadline, no rank_loss classification, no
+# single-host degrade) and one dead peer would hang the caller forever
+_COLLECTIVE_ALLOWED = {"distributed.py", "dist_resilience.py"}
+
+
+def test_raw_collectives_route_through_guarded_seam():
+    pkg_root = OPS_DIR.parent
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if path.name in _COLLECTIVE_ALLOWED:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            if "process_allgather" in stripped:
+                offenders.append(
+                    f"{path.relative_to(pkg_root)}:{lineno}: {stripped}")
+    assert not offenders, (
+        "raw multihost_utils.process_allgather outside the "
+        "guarded_collective seam (route it through "
+        "parallel/distributed.py so the collective watchdog, rank_loss "
+        "classification and single-host degrade cover it):\n"
+        + "\n".join(offenders))
+
+
+def test_collective_allowlist_is_minimal():
+    parallel_dir = OPS_DIR.parent / "parallel"
+    for name in _COLLECTIVE_ALLOWED:
+        assert (parallel_dir / name).is_file()
