@@ -339,7 +339,10 @@ def smoke() -> int:
     rc = escalate_smoke()
     if rc:
         return rc
-    return dist_chaos_smoke()
+    rc = dist_chaos_smoke()
+    if rc:
+        return rc
+    return fleet_chaos_smoke()
 
 
 def _smoke_frame():
@@ -1287,6 +1290,237 @@ def serve_chaos() -> int:
     return serve_chaos_smoke(_smoke_frame())
 
 
+def fleet_chaos_smoke(df=None) -> int:
+    """Fleet chaos A/B: kill one worker mid-traffic, nobody notices.
+
+    1. a solo clean single-server run establishes the reference frames
+       for two tables (A and B) in its own cache root;
+    2. a 2-worker FleetRouter serves pre-kill table-A traffic (latencies
+       recorded), then a table-B request carrying a rank-scoped
+       ``fault_plan`` ("<victim>:xfer.upload:1:rank_death") lands on B's
+       rendezvous-home worker and kills it mid-request, concurrent with
+       a clean table-A request;
+    3. the router must evict the dead worker and re-dispatch in-flight
+       work to the survivor: EVERY submitted request completes with 200
+       and a frame bit-identical to the clean single-server run (zero
+       dropped requests), ``fleet.evictions`` / ``fleet.redispatches`` /
+       ``fleet.dispatch_faults`` all fire, and ``/healthz`` reports
+       ``degraded`` with the victim evicted;
+    4. post-kill table-A traffic measures the degraded fleet (pre/post
+       p99 + QPS ride the JSON line).
+
+    Prints one JSON line; exit code 1 on failure."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from delphi_tpu.observability.fleet import FleetRouter, rendezvous_rank
+    from delphi_tpu.observability.serve import RepairServer, table_fingerprint
+
+    if df is None:
+        df = _smoke_frame()
+
+    # same knob shape as serve_chaos_smoke: force the guarded device
+    # domain route (so xfer.upload is on the hot path for the kill plan)
+    # and keep injected backoffs sub-millisecond
+    os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    prev_cc = os.environ.get("DELPHI_COMPILE_CACHE_DIR")
+
+    def _as_table(frame):
+        split = json.loads(frame.to_json(orient="split"))
+        return {c: [row[i] for row in split["data"]]
+                for i, c in enumerate(split["columns"])}
+
+    table_a = _as_table(df)
+    # the kill request repairs a DIFFERENT table: its fingerprint must be
+    # COLD fleet-wide so the victim runs the full guarded path (warm phase
+    # checkpoints would skip xfer.upload and the plan could never fire)
+    df_b = df.copy()
+    df_b["c2"] = [str((i * 5) % 3) for i in range(len(df_b))]
+    table_b = _as_table(df_b)
+    base_a = {"table": table_a, "row_id": "tid", "deadline_s": 600}
+    base_b = {"table": table_b, "row_id": "tid", "deadline_s": 600}
+
+    def post(port, body, timeout=600):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/repair",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+        except Exception as e:  # dropped request — the A/B forbids these
+            return None, {"error": f"{type(e).__name__}: {e}"}
+
+    # -- reference: clean single-server run in its own cache root ------------
+    _heartbeat("fleet chaos reference (clean single server)")
+    ref_cache = tempfile.mkdtemp(prefix="delphi_fleet_ref_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(ref_cache,
+                                                          "compile")
+    srv = RepairServer(port=0, workers=2, cache_dir=ref_cache).start()
+    try:
+        st_ref_a, ref_a = post(srv.port, dict(base_a, request_id="ref-a"))
+        st_ref_b, ref_b = post(srv.port, dict(base_b, request_id="ref-b"))
+    finally:
+        srv.drain(grace_s=10)
+
+    # -- fleet: 2 spawned workers sharing one cache root ---------------------
+    _heartbeat("fleet chaos fleet start (2 workers)")
+    fleet_cache = tempfile.mkdtemp(prefix="delphi_fleet_chaos_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(fleet_cache,
+                                                          "compile")
+    router = FleetRouter(
+        port=0, workers=2, cache_dir=fleet_cache, heartbeat_s=0.5,
+        worker_env={
+            # the workers must come up on the CPU backend no matter what
+            # the axon sitecustomize would pick for a fresh interpreter
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": None,
+            "DELPHI_MESH": "off",
+            "DELPHI_FLEET_HEARTBEAT_S": "0.5",
+        })
+    ok = False
+    info = {}
+    try:
+        router.start()
+        latencies = {"pre": [], "post": []}
+        results = {}
+
+        def timed_post(tag, body, bucket=None):
+            t0 = time.monotonic()
+            results[tag] = post(router.port, body)
+            if bucket is not None:
+                latencies[bucket].append(time.monotonic() - t0)
+
+        _heartbeat("fleet chaos pre-kill traffic")
+        t_pre = time.monotonic()
+        timed_post("pre-1", dict(base_a, request_id="pre-1"), "pre")
+        timed_post("pre-2", dict(base_a, request_id="pre-2"), "pre")
+        pre_elapsed = time.monotonic() - t_pre
+
+        # the kill: table B's rendezvous home dies mid-request, while a
+        # clean table-A request is in flight on the fleet
+        live = router.refresh_membership()
+        victim = rendezvous_rank(table_fingerprint(table_b, "tid"), live)[0]
+        kill_plan = f"{victim}:xfer.upload:1:rank_death"
+        _heartbeat(f"fleet chaos kill (victim worker {victim})")
+        threads = [
+            threading.Thread(target=timed_post,
+                             args=("kill", dict(base_b, request_id="kill",
+                                                fault_plan=kill_plan))),
+            threading.Thread(target=timed_post,
+                             args=("mid", dict(base_a, request_id="mid"))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        _heartbeat("fleet chaos post-kill traffic")
+        t_post = time.monotonic()
+        timed_post("post-1", dict(base_a, request_id="post-1"), "post")
+        timed_post("post-2", dict(base_a, request_id="post-2"), "post")
+        post_elapsed = time.monotonic() - t_post
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+
+        def metric(name):
+            for line in metrics.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[1])
+            return 0.0
+
+        a_tags = ("pre-1", "pre-2", "mid", "post-1", "post-2")
+        checks = {
+            "reference_ok": st_ref_a == 200 and st_ref_b == 200,
+            "zero_dropped": all(results.get(t, (None, {}))[0] == 200
+                                for t in a_tags + ("kill",)),
+            "frames_a_bit_identical": all(
+                results.get(t, (0, {}))[1].get("frame") == ref_a.get("frame")
+                for t in a_tags),
+            "kill_frame_bit_identical":
+                results.get("kill", (0, {}))[1].get("frame")
+                == ref_b.get("frame"),
+            "victim_process_dead":
+                router._procs[victim].poll() is not None,
+            "evictions_fired": metric("delphi_fleet_evictions") >= 1,
+            "redispatches_fired": metric("delphi_fleet_redispatches") >= 1,
+            "dispatch_faults_fired":
+                metric("delphi_fleet_dispatch_faults") >= 1,
+            "healthz_degraded": health.get("status") == "degraded"
+                and victim in (health.get("evicted") or {}),
+        }
+        ok = all(checks.values())
+        info = {
+            "victim": victim, "plan": kill_plan, "checks": checks,
+            "pre_kill": {
+                "p99_s": round(max(latencies["pre"] or [0.0]), 3),
+                "qps": round(len(latencies["pre"])
+                             / max(pre_elapsed, 1e-9), 3),
+            },
+            "post_kill": {
+                "p99_s": round(max(latencies["post"] or [0.0]), 3),
+                "qps": round(len(latencies["post"])
+                             / max(post_elapsed, 1e-9), 3),
+            },
+            "fleet": {
+                "evictions": metric("delphi_fleet_evictions"),
+                "redispatches": metric("delphi_fleet_redispatches"),
+                "dispatch_faults": metric("delphi_fleet_dispatch_faults"),
+                "rejoins": metric("delphi_fleet_rejoins"),
+            },
+            "statuses": {t: results.get(t, (None, {}))[0]
+                         for t in a_tags + ("kill",)},
+        }
+    finally:
+        router.drain()
+        os.environ.pop("DELPHI_DOMAIN_DEVICE", None)
+        os.environ.pop("DELPHI_RETRY_BASE_S", None)
+        os.environ.pop("DELPHI_COMPILE_CACHE_MIN_S", None)
+        if prev_cc is None:
+            os.environ.pop("DELPHI_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["DELPHI_COMPILE_CACHE_DIR"] = prev_cc
+
+    print(json.dumps({
+        "metric": "fleet_chaos_smoke", "value": 1 if ok else 0,
+        "unit": "pass", "vs_baseline": None, "ok": ok, **info,
+    }), flush=True)
+    if not ok:
+        print("fleet chaos smoke FAILED: killing one worker mid-traffic "
+              "must evict + re-dispatch with every response bit-identical "
+              f"to a clean single-server run ({info.get('checks')})",
+              file=sys.stderr)
+        for wid in sorted(getattr(router, "_procs", {})):
+            try:
+                with open(router._worker_log_path(wid)) as f:
+                    tail = f.read()[-2000:]
+                print(f"--- fleet worker {wid} log tail ---\n{tail}",
+                      file=sys.stderr)
+            except OSError:
+                pass
+        return 1
+    return 0
+
+
+def fleet_chaos() -> int:
+    """Standalone `bench.py --fleet-chaos` entry: CPU backend, 2-worker
+    repair fleet, one worker killed mid-traffic (see fleet_chaos_smoke)."""
+    _force_cpu_backend()
+    return fleet_chaos_smoke(_smoke_frame())
+
+
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
 # On-chip measurements persist here keyed by workload@scale: the axon tunnel
@@ -1542,6 +1776,16 @@ def main() -> None:
                              "them, asserting the clean request stays "
                              "bit-identical to a solo run and warm caches "
                              "survive; exits 1 on failure")
+    parser.add_argument("--fleet-chaos", dest="fleet_chaos",
+                        action="store_true",
+                        help="elastic fleet chaos A/B on the CPU backend: "
+                             "a 2-worker repair fleet behind the "
+                             "FleetRouter, one worker killed mid-traffic "
+                             "by a rank-scoped rank_death plan, asserting "
+                             "eviction + re-dispatch with every completed "
+                             "response bit-identical to a clean single-"
+                             "server run and zero dropped requests; exits "
+                             "1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -1563,6 +1807,9 @@ def main() -> None:
 
     if args.serve_chaos:
         sys.exit(serve_chaos())
+
+    if args.fleet_chaos:
+        sys.exit(fleet_chaos())
 
     if args._child:
         _child_main(args)

@@ -20,7 +20,10 @@ snapshot; the first run populates it. See docs/source/incremental.rst.
 Service mode: `--serve [--serve-port P] [--serve-cache-dir D]` skips the
 batch arguments entirely and runs the persistent repair service
 (`delphi_tpu/observability/serve.py`): POST /repair, GET /metrics //healthz
-//report, graceful drain on SIGTERM. See docs/source/robustness.rst.
+//report, graceful drain on SIGTERM. `--fleet N` scales that out: N repair
+workers sharing one cache root behind a rendezvous-hashing router with
+liveness-routed failover (`delphi_tpu/observability/fleet.py`). See
+docs/source/robustness.rst.
 """
 
 import argparse
@@ -61,6 +64,15 @@ def main(argv=None) -> int:
                              "restarts warm. Equivalent to "
                              "DELPHI_SERVE_CACHE_DIR / "
                              "repair.serve.cache_dir")
+    parser.add_argument("--fleet", dest="fleet", type=int, default=0,
+                        help="run an elastic repair fleet instead of a "
+                             "single service: spawn N repair workers "
+                             "sharing the --serve-cache-dir warm state "
+                             "behind a rendezvous-hashing router with "
+                             "liveness-routed failover (POST /repair on "
+                             "--serve-port; docs/source/robustness.rst). "
+                             "Equivalent to DELPHI_FLEET_WORKERS / "
+                             "repair.fleet.workers")
     parser.add_argument("--targets", dest="targets", type=str, default="",
                         help="comma-separated target attributes")
     parser.add_argument("--constraints", dest="constraints", type=str, default="",
@@ -212,6 +224,12 @@ def main(argv=None) -> int:
     from delphi_tpu.parallel.distributed import maybe_initialize_distributed
     maybe_initialize_distributed()
 
+    if args.fleet > 0:
+        if args.fault_plan:
+            session.conf["repair.fault.plan"] = args.fault_plan
+        from delphi_tpu.observability.fleet import run_fleet
+        return run_fleet(port=args.serve_port, workers=args.fleet,
+                         cache_dir=args.serve_cache_dir or None)
     if args.serve:
         if args.fault_plan:
             session.conf["repair.fault.plan"] = args.fault_plan

@@ -1,0 +1,649 @@
+"""Elastic repair fleet: N ``RepairServer`` workers behind one router.
+
+One warm process is one fault domain. ``FleetRouter`` scales the serving
+plane out: it spawns (or attaches to) N worker processes that share one
+cache root — compile cache, snapshot dirs, per-fingerprint model and
+phase checkpoints all live under it — and fronts them with the same
+stdlib HTTP stack as :mod:`delphi_tpu.observability.serve`.
+
+Routing is **rendezvous hashing** on the request's table fingerprint
+(:func:`~delphi_tpu.observability.serve.table_fingerprint`, the same
+blob the workers' warm-table caches key on): the highest-scoring live
+worker owns a fingerprint, so repeated tables land on the replica whose
+device buffers, models, and compiled executables are already warm, and a
+membership change only remaps the fingerprints that scored the departed
+worker highest — every other fingerprint keeps its home.
+
+Membership is **derived from the dist-resilience liveness files**: each
+worker heartbeats ``rank_<id>.alive`` under the shared fleet dir (the
+exact file format the PR 11 rank diagnosis reads), and the router's
+:meth:`FleetRouter.refresh_membership` scan evicts any worker whose
+stamp goes stale — stalled and dead look identical from outside, and
+both mean "stop routing there". A cleanly draining worker unregisters
+*before* closing admission, so the ring shrinks ahead of the 503s.
+
+Failure handling on the hot path:
+
+* a worker answering **429/503-rejected** is shedding, not broken — the
+  router hops to the next-ranked live replica, bounded by
+  ``DELPHI_FLEET_MAX_HOPS``, and if *every* live worker sheds it returns
+  429 with the **max** observed ``Retry-After`` (never loops);
+* a **connection-level failure** (refused/reset — the worker died
+  between the membership check and the dispatch) is a ``fleet.dispatch``
+  fault: the worker is evicted, its liveness file dropped (a genuinely
+  live worker re-touches within one heartbeat and rejoins), and the
+  in-flight request is **re-dispatched** to the next-ranked replica —
+  idempotent because every request runs under its own ``RequestScope``
+  and the response ordering is canonical, so the retry is bit-identical
+  to what the dead worker would have answered;
+* any other response (200/400/500/504) is definitive and returned
+  as-is — a deterministic failure would only repeat elsewhere.
+
+The evicted worker's fingerprints rendezvous-remap to the survivors,
+which **rewarm from the shared cache root** (model + phase checkpoints,
+compile cache) instead of recomputing from scratch.
+
+All dispatch I/O goes through ONE guarded helper,
+:meth:`FleetRouter._dispatch_once` (site ``fleet.dispatch``, registered
+in ``KNOWN_SITES`` and chaos-injectable); ``tests/test_transfer_guard``
+statically pins that. ``fleet.*`` counters are pre-seeded on the
+router's ``/metrics`` at start, and fleet membership rides the run
+report's ``dist`` section.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from delphi_tpu.observability.registry import counter_inc, gauge_set
+from delphi_tpu.observability.serve import (
+    _knob_float, _knob_int, table_fingerprint,
+)
+from delphi_tpu.utils import setup_logger
+
+_logger = setup_logger()
+
+_DEF_FLEET_WORKERS = 2
+_DEF_MAX_HOPS = 3
+_DEF_SPAWN_TIMEOUT_S = 180.0
+
+#: Pre-seeded at router start so a scrape before the first request (or the
+#: first fault) sees the whole fleet series at zero, not a missing metric.
+_SEED_COUNTERS = (
+    "fleet.requests", "fleet.dispatches", "fleet.redispatches",
+    "fleet.evictions", "fleet.rejoins", "fleet.dispatch_faults",
+    "fleet.all_shed", "fleet.no_workers",
+    "fleet.affinity.hits", "fleet.affinity.misses",
+)
+
+
+def rendezvous_rank(fp: str, members: List[str]) -> List[str]:
+    """Members ordered by rendezvous (highest-random-weight) score for
+    fingerprint ``fp``, best first. Removing a member never reorders the
+    survivors — only the fingerprints the departed member owned remap —
+    which is exactly the warm-state-preserving property the fleet needs
+    (consistent-hash rings buy the same at far more code)."""
+    return sorted(
+        members,
+        key=lambda m: hashlib.sha1(f"{fp}|{m}".encode()).digest(),
+        reverse=True)
+
+
+class DispatchFault(Exception):
+    """A connection-level dispatch failure (refused/reset/timeout) to one
+    worker — the signal that the worker, not the request, is broken."""
+
+    def __init__(self, worker_id: str, cause: BaseException) -> None:
+        self.worker_id = worker_id
+        self.cause = cause
+        super().__init__(f"worker {worker_id}: "
+                         f"{type(cause).__name__}: {cause}")
+
+
+class FleetRouter:
+    """The fleet front-end. Lifecycle: ``start()`` → (requests...) →
+    ``drain()`` (SIGTERMs spawned workers, then stops) or ``stop()``.
+    ``spawn=False`` attaches to externally started workers that registered
+    under the same cache root."""
+
+    def __init__(self, port: int = 0, workers: Optional[int] = None,
+                 cache_dir: Optional[str] = None, spawn: bool = True,
+                 max_hops: Optional[int] = None,
+                 heartbeat_s: Optional[float] = None,
+                 worker_env: Optional[Dict[str, Optional[str]]] = None
+                 ) -> None:
+        import tempfile
+
+        self.requested_port = int(port)
+        self.n_workers = workers if workers is not None else _knob_int(
+            "DELPHI_FLEET_WORKERS", "repair.fleet.workers",
+            _DEF_FLEET_WORKERS)
+        self.n_workers = max(1, int(self.n_workers))
+        cache = cache_dir or os.environ.get("DELPHI_SERVE_CACHE_DIR")
+        self.cache_dir = str(cache) if cache else tempfile.mkdtemp(
+            prefix="delphi_fleet_")
+        self.fleet_dir = os.path.join(self.cache_dir, "fleet")
+        self.spawn = bool(spawn)
+        self.max_hops = max_hops if max_hops is not None else _knob_int(
+            "DELPHI_FLEET_MAX_HOPS", "repair.fleet.max_hops", _DEF_MAX_HOPS)
+        self.max_hops = max(1, int(self.max_hops))
+        self.heartbeat_s = heartbeat_s if heartbeat_s is not None \
+            else _knob_float("DELPHI_FLEET_HEARTBEAT_S",
+                             "repair.fleet.heartbeat_s", 1.0)
+        self.spawn_timeout_s = _knob_float(
+            "DELPHI_FLEET_SPAWN_TIMEOUT_S", "repair.fleet.spawn_timeout_s",
+            _DEF_SPAWN_TIMEOUT_S)
+        self.dispatch_timeout_s = _knob_float(
+            "DELPHI_SERVE_DEADLINE_S", "repair.serve.deadline_s",
+            300.0) + 30.0
+        self.worker_env = dict(worker_env or {})
+
+        self.recorder: Optional[Any] = None
+        self._own_recorder: Optional[Any] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        # worker id -> registration info ({"port", "pid", "cache_dir", ...})
+        self._workers: Dict[str, Dict[str, Any]] = {}
+        self._evicted: Dict[str, str] = {}     # worker id -> reason
+        self._live: List[str] = []
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "FleetRouter":
+        from delphi_tpu import observability as obs
+
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self._own_recorder = obs.start_recording("repair.fleet")
+        self.recorder = self._own_recorder or obs.current_recorder()
+        if self.recorder is None:  # pragma: no cover - defensive
+            raise RuntimeError("fleet router requires a run recorder")
+        for name in _SEED_COUNTERS:
+            counter_inc(name, 0)
+        gauge_set("fleet.workers", 0)
+        gauge_set("fleet.live_workers", 0)
+        gauge_set("fleet.evicted_workers", 0)
+
+        if self.spawn:
+            for i in range(self.n_workers):
+                self._spawn_worker(str(i))
+        self._await_registrations()
+        self.refresh_membership()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.requested_port),
+                                          _FleetHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.fleet_router = self  # type: ignore[attr-defined]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="delphi-fleet-http")
+        self._http_thread.start()
+        with self._lock:
+            live = list(self._live)
+        _logger.info(f"fleet router listening on 127.0.0.1:{self.port} "
+                     f"(workers={sorted(self._workers)}, live={live}, "
+                     f"cache={self.cache_dir})")
+        return self
+
+    def _worker_log_path(self, wid: str) -> str:
+        return os.path.join(self.fleet_dir, f"worker_{wid}.log")
+
+    def _spawn_worker(self, wid: str) -> None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["DELPHI_FLEET_DIR"] = self.fleet_dir
+        env["DELPHI_FLEET_WORKER_ID"] = wid
+        # the worker's identity for rank-scoped fault plans: a plan like
+        # "1:xfer.upload:1:rank_death" kills ONLY worker 1's copy of the
+        # request, which is what the chaos A/B leans on
+        env["DELPHI_PROCESS_ID"] = wid
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        for key, value in self.worker_env.items():
+            if value is None:
+                env.pop(key, None)
+            else:
+                env[key] = str(value)
+        cmd = [sys.executable, "-m", "delphi_tpu.main", "--serve",
+               "--serve-port", "0", "--serve-cache-dir", self.cache_dir]
+        log = open(self._worker_log_path(wid), "w")
+        try:
+            proc = subprocess.Popen(cmd, env=env, cwd=repo_root,
+                                    stdout=log, stderr=subprocess.STDOUT)
+        finally:
+            log.close()
+        self._procs[wid] = proc
+        _logger.info(f"spawned fleet worker {wid} (pid {proc.pid})")
+
+    def _await_registrations(self) -> None:
+        """Blocks until every spawned worker has written its registration
+        file; a worker that exits before registering fails the start
+        loudly with its log tail (a silently short fleet would masquerade
+        as a healthy smaller one)."""
+        want = set(self._procs)
+        if not want:
+            return
+        deadline = time.monotonic() + max(1.0, self.spawn_timeout_s)
+        while time.monotonic() < deadline:
+            regs = self._read_registrations()
+            if want <= set(regs):
+                return
+            for wid, proc in self._procs.items():
+                if wid not in regs and proc.poll() is not None:
+                    tail = ""
+                    try:
+                        with open(self._worker_log_path(wid)) as f:
+                            tail = f.read()[-2000:]
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"fleet worker {wid} exited rc={proc.returncode} "
+                        f"before registering:\n{tail}")
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"fleet workers {sorted(want - set(self._read_registrations()))} "
+            f"did not register within {self.spawn_timeout_s:.0f}s")
+
+    def drain(self) -> None:
+        """Graceful fleet shutdown: SIGTERM every spawned worker (each
+        unregisters first, then drains its own queue), wait for them,
+        then stop the router."""
+        for wid, proc in self._procs.items():
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    pass
+        for wid, proc in self._procs.items():
+            try:
+                proc.wait(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                _logger.warning(f"fleet worker {wid} ignored SIGTERM; "
+                                "killing")
+                proc.kill()
+        self.stop()
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=10.0)
+            self._httpd = None
+        if self._own_recorder is not None:
+            from delphi_tpu import observability as obs
+            obs.stop_recording(self._own_recorder)
+            self._own_recorder = None
+        _logger.info("fleet router stopped")
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    # -- membership ----------------------------------------------------------
+
+    def _read_registrations(self) -> Dict[str, Dict[str, Any]]:
+        regs: Dict[str, Dict[str, Any]] = {}
+        try:
+            names = os.listdir(self.fleet_dir)
+        except OSError:
+            return regs
+        for name in sorted(names):
+            if not (name.startswith("worker_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.fleet_dir, name)) as f:
+                    info = json.load(f)
+                regs[str(info["worker_id"])] = info
+            except Exception:
+                continue  # half-written registration; next scan gets it
+        return regs
+
+    def refresh_membership(self, now: Optional[float] = None) -> List[str]:
+        """One membership scan: merge worker registrations (new workers
+        join the ring elastically), read every liveness file, evict
+        workers whose stamp is stale or missing, rejoin workers that came
+        back, and drop workers that unregistered cleanly (graceful
+        departure, not an eviction). Returns the live ring."""
+        from delphi_tpu.parallel import dist_resilience as dr
+
+        regs = self._read_registrations()
+        members = dr.scan_membership(self.fleet_dir, self.heartbeat_s,
+                                     now=now)
+        with self._lock:
+            for wid, info in regs.items():
+                self._workers[wid] = info
+            for wid in list(self._workers):
+                if wid not in regs:
+                    # registration gone: the worker drained out cleanly
+                    self._workers.pop(wid, None)
+                    self._evicted.pop(wid, None)
+                    _logger.info(f"fleet worker {wid} departed (drained)")
+            live: List[str] = []
+            for wid in sorted(self._workers):
+                status = members.get(wid, {}).get("status", "unknown")
+                if status == "live":
+                    if wid in self._evicted:
+                        del self._evicted[wid]
+                        counter_inc("fleet.rejoins")
+                        _logger.info(f"fleet worker {wid} rejoined the ring")
+                    live.append(wid)
+                elif wid not in self._evicted:
+                    self._evicted[wid] = f"liveness {status}"
+                    counter_inc("fleet.evictions")
+                    _logger.warning(f"fleet worker {wid} evicted: "
+                                    f"liveness {status}")
+            self._live = live
+            n_workers, n_evicted = len(self._workers), len(self._evicted)
+        gauge_set("fleet.workers", n_workers)
+        gauge_set("fleet.live_workers", len(live))
+        gauge_set("fleet.evicted_workers", n_evicted)
+        self._publish_dist_section()
+        return list(live)
+
+    def _evict(self, wid: str, reason: str,
+               drop_liveness: bool = False) -> None:
+        """Dispatch-fault eviction. ``drop_liveness`` removes the dead
+        worker's liveness file so the stale stamp can't flap it back on
+        the very next scan — a worker that is actually alive re-touches
+        within one heartbeat and rejoins."""
+        from delphi_tpu.parallel import dist_resilience as dr
+
+        with self._lock:
+            if wid in self._live:
+                self._live.remove(wid)
+            already = wid in self._evicted
+            if not already:
+                self._evicted[wid] = reason
+        if not already:
+            counter_inc("fleet.evictions")
+            _logger.warning(f"fleet worker {wid} evicted: {reason}")
+        if drop_liveness:
+            try:
+                os.remove(dr.member_liveness_path(self.fleet_dir, wid))
+            except OSError:
+                pass
+        gauge_set("fleet.live_workers", len(self._live))
+        gauge_set("fleet.evicted_workers", len(self._evicted))
+        self._publish_dist_section()
+
+    def _publish_dist_section(self) -> None:
+        """Rolls fleet membership into the run report's ``dist`` section
+        (merged over the dist-resilience section when one exists)."""
+        from delphi_tpu.parallel import dist_resilience as dr
+
+        try:
+            section = dict(dr.report_section() or {})
+        except Exception:  # pragma: no cover - defensive
+            section = {}
+        with self._lock:
+            section["fleet"] = {
+                "workers": sorted(self._workers),
+                "live": list(self._live),
+                "evicted": dict(self._evicted),
+            }
+        if self.recorder is not None:
+            self.recorder.dist = section
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch_once(self, wid: str, data: bytes, timeout_s: float
+                       ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """The ONE place router→worker HTTP happens: a guarded seam at
+        site ``fleet.dispatch`` (chaos-injectable, abort-aware). Returns
+        ``(status, body, headers)`` for any HTTP answer — including the
+        worker's 4xx/5xx — and raises :class:`DispatchFault` for
+        connection-level failures, which the caller treats as the worker
+        dying between the membership check and the dispatch."""
+        from delphi_tpu.parallel import resilience
+
+        resilience.maybe_abort()
+        with self._lock:
+            info = self._workers.get(wid)
+        port = (info or {}).get("port")
+        try:
+            resilience._maybe_inject("fleet.dispatch")
+            if not port:
+                raise OSError(f"worker {wid} has no registered port "
+                              "(connection refused)")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{int(port)}/repair", data=data,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                body = json.loads(resp.read() or b"{}")
+                return int(resp.status), body, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except Exception:
+                body = {"status": "error", "error": f"HTTP {e.code}"}
+            return int(e.code), body, dict(e.headers or {})
+        except Exception as e:
+            raise DispatchFault(wid, e)
+
+    @staticmethod
+    def _retry_after_s(headers: Dict[str, str]) -> float:
+        for key, value in headers.items():
+            if key.lower() == "retry-after":
+                try:
+                    return float(value)
+                except (TypeError, ValueError):
+                    break
+        return 1.0
+
+    def handle_repair(self, payload: Dict[str, Any]
+                      ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        """Routes one /repair request: rendezvous-rank the live ring on
+        the table fingerprint, dispatch to the best untried worker, hop
+        on shed (429/503-rejected), evict + re-dispatch on connection
+        faults, return anything else as definitive. Bounded by
+        ``max_hops`` and the monotonically growing tried-set, so the
+        router can never loop. Returns ``(status, body,
+        retry_after_s)``."""
+        from delphi_tpu.parallel import resilience
+
+        counter_inc("fleet.requests")
+        fp = table_fingerprint(payload["table"], payload["row_id"])
+        data = json.dumps(payload).encode()
+        tried: set = set()
+        shed_retry_afters: List[float] = []
+        hops = 0
+        saw_worker = False
+        while hops < self.max_hops:
+            live = self.refresh_membership()
+            ranked = rendezvous_rank(fp, live)
+            candidates = [w for w in ranked if w not in tried]
+            if not candidates:
+                break
+            saw_worker = True
+            wid = candidates[0]
+            tried.add(wid)
+            hops += 1
+            counter_inc("fleet.dispatches")
+            if hops > 1:
+                counter_inc("fleet.redispatches")
+            # affinity: did this request land on its rendezvous home?
+            counter_inc("fleet.affinity.hits" if wid == ranked[0]
+                        else "fleet.affinity.misses")
+            try:
+                status, body, headers = self._dispatch_once(
+                    wid, data, self.dispatch_timeout_s)
+            except DispatchFault as e:
+                counter_inc("fleet.dispatch_faults")
+                kind = resilience.classify_fault(e.cause) or "transient"
+                self._evict(wid, f"dispatch fault ({kind}): {e.cause}",
+                            drop_liveness=True)
+                _logger.warning(f"fleet.dispatch fault on worker {wid} "
+                                f"({kind}); re-dispatching")
+                continue
+            shedding = status in (429, 503) \
+                and body.get("status") == "rejected"
+            if shedding:
+                shed_retry_afters.append(self._retry_after_s(headers))
+                continue
+            return status, body, None
+        if shed_retry_afters:
+            counter_inc("fleet.all_shed")
+            return (429, {"status": "rejected",
+                          "error": "all live workers are shedding"},
+                    max(shed_retry_afters))
+        if not saw_worker:
+            counter_inc("fleet.no_workers")
+            return (503, {"status": "rejected",
+                          "error": "no live fleet workers"}, 1.0)
+        return (503, {"status": "error",
+                      "error": f"no live worker completed the request "
+                               f"after {hops} dispatch(es) to "
+                               f"{len(tried)} worker(s)"},
+                1.0)
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _logger.debug("fleet router: " + fmt % args)
+
+    @property
+    def _router(self) -> FleetRouter:
+        return self.server.fleet_router  # type: ignore[attr-defined]
+
+    def _respond(self, status: int, body: Dict[str, Any],
+                 retry_after_s: Optional[float] = None) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        if retry_after_s is not None:
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after_s)))))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        from delphi_tpu.observability.live import (
+            PROMETHEUS_CONTENT_TYPE, render_prometheus,
+        )
+
+        rt = self._router
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                live = rt.refresh_membership()
+                with rt._lock:
+                    evicted = dict(rt._evicted)
+                    workers = {
+                        wid: {"port": info.get("port"),
+                              "live": wid in live,
+                              "evicted_reason": evicted.get(wid)}
+                        for wid, info in sorted(rt._workers.items())}
+                self._respond(200, {
+                    "status": "degraded" if evicted else "ok",
+                    "live": live,
+                    "evicted": evicted,
+                    "workers": workers,
+                })
+            elif path == "/metrics":
+                text = render_prometheus(rt.recorder).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            elif path == "/report":
+                from delphi_tpu.observability.report import build_run_report
+                rt._publish_dist_section()
+                report = build_run_report(rt.recorder, run={},
+                                          status="serving", error=None)
+                self._respond(200, report)
+            else:
+                self._respond(404, {"error": f"unknown path {path}"})
+        except Exception as e:  # pragma: no cover - defensive
+            try:
+                self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        rt = self._router
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/drain":
+                threading.Thread(target=rt.drain, daemon=True,
+                                 name="delphi-fleet-drain").start()
+                self._respond(200, {"status": "draining"})
+                return
+            if path != "/repair":
+                self._respond(404, {"error": f"unknown path {path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._respond(400, {"status": "bad_request",
+                                    "error": f"bad JSON body: {e}"})
+                return
+            if not isinstance(payload, dict) \
+                    or not isinstance(payload.get("table"), dict) \
+                    or not isinstance(payload.get("row_id"), str):
+                self._respond(400, {
+                    "status": "bad_request",
+                    "error": "body must be a JSON object with a 'table' "
+                             "object and a 'row_id' string"})
+                return
+            status, body, retry_after_s = rt.handle_repair(payload)
+            self._respond(status, body, retry_after_s=retry_after_s)
+        except Exception as e:  # pragma: no cover - defensive
+            try:
+                self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+def install_signal_handlers(router: FleetRouter) -> None:
+    """SIGTERM/SIGINT → drain the whole fleet (main-thread only)."""
+    def _handler(signum: int, frame: Any) -> None:
+        _logger.info(f"signal {signum}: draining repair fleet")
+        threading.Thread(target=router.drain, daemon=True,
+                         name="delphi-fleet-drain").start()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+
+
+def run_fleet(port: int = 8080, workers: Optional[int] = None,
+              cache_dir: Optional[str] = None) -> int:
+    """Blocking entry point for ``main.py --fleet N``: spawns the
+    workers, starts the router, and waits until a drain completes."""
+    router = FleetRouter(port=port, workers=workers, cache_dir=cache_dir)
+    router.start()
+    install_signal_handlers(router)
+    print(f"delphi repair fleet on 127.0.0.1:{router.port} "
+          f"({router.n_workers} workers, cache {router.cache_dir})",
+          flush=True)
+    router.wait()
+    return 0

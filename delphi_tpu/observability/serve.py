@@ -93,6 +93,17 @@ _DEF_DEADLINE_S = 300.0
 _DEF_RETRY_AFTER_S = 1.0
 _DEF_DRAIN_GRACE_S = 30.0
 _DEF_STALL_SHED_S = 120.0
+_DEF_FLEET_HEARTBEAT_S = 1.0
+
+
+def table_fingerprint(table: Dict[str, Any], row_id: str) -> str:
+    """Content fingerprint of one /repair request's table. The SINGLE
+    definition shared by the server's warm-table cache and the fleet
+    router's rendezvous hashing — affinity only works because both sides
+    hash the identical blob."""
+    blob = json.dumps({"row_id": row_id, "table": table},
+                      sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()
 
 #: Counters pre-seeded to zero at server start so the Prometheus endpoint
 #: always exposes the full admission/resilience series (a scrape before the
@@ -179,7 +190,9 @@ class RepairServer:
 
     def __init__(self, port: int = 0, workers: Optional[int] = None,
                  queue_depth: Optional[int] = None,
-                 cache_dir: Optional[str] = None) -> None:
+                 cache_dir: Optional[str] = None,
+                 fleet_dir: Optional[str] = None,
+                 worker_id: Optional[str] = None) -> None:
         self.requested_port = int(port)
         self.workers = workers if workers is not None else _knob_int(
             "DELPHI_SERVE_WORKERS", "repair.serve.workers", _DEF_WORKERS)
@@ -211,6 +224,19 @@ class RepairServer:
         self.stall_shed_s = _knob_float(
             "DELPHI_SERVE_STALL_SHED_S", "repair.serve.stall_shed_s",
             _DEF_STALL_SHED_S)
+        # fleet membership seam (observability/fleet.py): when armed, the
+        # worker registers itself under the shared fleet dir and keeps a
+        # liveness heartbeat the router's membership scan reads
+        fleet = fleet_dir or os.environ.get("DELPHI_FLEET_DIR")
+        self.fleet_dir = str(fleet) if fleet else None
+        wid = (worker_id if worker_id is not None
+               else os.environ.get("DELPHI_FLEET_WORKER_ID"))
+        self.worker_id = str(wid) if wid is not None else None
+        self.fleet_heartbeat_s = _knob_float(
+            "DELPHI_FLEET_HEARTBEAT_S", "repair.fleet.heartbeat_s",
+            _DEF_FLEET_HEARTBEAT_S)
+        self._fleet_thread: Optional[threading.Thread] = None
+        self._fleet_stop: Optional[threading.Event] = None
 
         self.recorder: Optional[Any] = None
         self._own_recorder: Optional[Any] = None
@@ -291,11 +317,75 @@ class RepairServer:
             target=self._httpd.serve_forever, daemon=True,
             name="delphi-serve-http")
         self._http_thread.start()
+        self._register_fleet_worker()
         _logger.info(
             f"repair service listening on 127.0.0.1:{self.port} "
             f"(workers={self.workers}, queue={self.queue_depth}, "
             f"cache={self.cache_dir})")
         return self
+
+    # -- fleet membership ----------------------------------------------------
+
+    def _fleet_registration_path(self) -> Optional[str]:
+        if not self.fleet_dir or self.worker_id is None:
+            return None
+        return os.path.join(self.fleet_dir, f"worker_{self.worker_id}.json")
+
+    def _register_fleet_worker(self) -> None:
+        """Announces this worker to the fleet router: an atomic
+        registration file (the bound ephemeral port — the one fact the
+        router cannot know before spawn) plus a heartbeat-refreshed
+        liveness file, the same file format the dist-resilience plane
+        uses for rank diagnosis."""
+        reg = self._fleet_registration_path()
+        if reg is None:
+            return
+        from delphi_tpu.parallel import dist_resilience as dr
+
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        tmp = reg + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"worker_id": self.worker_id, "port": self.port,
+                       "pid": os.getpid(), "cache_dir": self.cache_dir,
+                       "started": float(time.time())}, f)
+        os.replace(tmp, reg)
+        live = dr.member_liveness_path(self.fleet_dir, self.worker_id)
+        dr.touch_liveness_file(live)
+        stop = threading.Event()
+        interval = max(0.05, float(self.fleet_heartbeat_s))
+
+        def _beat() -> None:
+            while not stop.wait(interval):
+                dr.touch_liveness_file(live)
+
+        t = threading.Thread(target=_beat, daemon=True,
+                             name="delphi-fleet-heartbeat")
+        t.start()
+        self._fleet_stop, self._fleet_thread = stop, t
+        _logger.info(f"fleet worker {self.worker_id} registered in "
+                     f"{self.fleet_dir} (port {self.port})")
+
+    def unregister_fleet_worker(self) -> None:
+        """Drops this worker out of fleet membership: stops the
+        heartbeat, then removes the liveness and registration files so
+        the router's next membership scan routes around it. Idempotent;
+        a no-op outside a fleet."""
+        reg = self._fleet_registration_path()
+        if reg is None:
+            return
+        if self._fleet_stop is not None:
+            self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=5.0)
+            self._fleet_thread = None
+        from delphi_tpu.parallel import dist_resilience as dr
+        live = dr.member_liveness_path(self.fleet_dir, self.worker_id)
+        for path in (live, reg):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        _logger.info(f"fleet worker {self.worker_id} unregistered")
 
     def _rebuild_warm_state(self) -> None:
         """Crash-safe warm-state inventory on (re)start: count the model
@@ -321,10 +411,17 @@ class RepairServer:
                          f"{self.cache_dir}")
 
     def begin_drain(self) -> None:
-        """Stops admission; in-flight and queued work keeps running."""
+        """Stops admission; in-flight and queued work keeps running.
+        Under a fleet, membership is dropped FIRST — the router must stop
+        sending new work here (its next scan sees the liveness file gone)
+        before admission closes, otherwise every request routed during
+        the drain window eats a 503 hop instead of landing on a live
+        replica directly."""
         with self._lock:
             if self._draining:
                 return
+        self.unregister_fleet_worker()
+        with self._lock:
             self._draining = True
         gauge_set("serve.draining", 1)
         _logger.info("repair service draining: admission closed")
@@ -368,6 +465,7 @@ class RepairServer:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        self.unregister_fleet_worker()
         for _ in self._workers:
             try:
                 self._queue.put_nowait(None)
@@ -497,9 +595,7 @@ class RepairServer:
 
         table = payload["table"]
         row_id = payload["row_id"]
-        blob = json.dumps({"row_id": row_id, "table": table},
-                          sort_keys=True, default=str)
-        fp = hashlib.sha1(blob.encode()).hexdigest()
+        fp = table_fingerprint(table, row_id)
         with self._lock:
             cached = self._tables.get(fp)
         if cached is not None:

@@ -31,8 +31,9 @@ from delphi_tpu.utils import setup_logger
 _logger = setup_logger()
 
 # One-shot marker for the multi-process lower-bound trace (see
-# `_merge_global_many`); module-level so it logs once per process, not once
-# per stats instance.
+# `PairDistinctCounter._merge_lower_bound`); module-level so it logs once
+# per process, not once per stats instance. Since the exact key-set merge
+# landed this only fires on the degraded (rank-loss) fallback.
 _lower_bound_logged = False
 
 Pair = Tuple[str, str]
@@ -341,34 +342,68 @@ class PairDistinctCounter:
             self._global_rows_cache = n
         return self._global_rows_cache
 
-    def _merge_global(self, count: int) -> int:
-        """Cross-process merge of a per-shard distinct-pair count: the MAX
-        over shards — a deterministic lower bound of the global distinct
-        count (exactness would need the pair matrix the pruning exists to
-        avoid). Every process derives the identical value, so candidate
+    def _merge_lower_bound(self, counts: List[int]) -> List[int]:
+        """DEGRADED cross-process merge of per-shard distinct-pair counts:
+        the MAX over shards — a deterministic lower bound of the global
+        distinct count, used only when the exact key-set gather is
+        unavailable (rank loss latched the collective plane). Every
+        surviving process derives the identical value, so candidate
         selection stays consistent across the cluster."""
-        return self._merge_global_many([count])[0]
-
-    def _merge_global_many(self, counts: List[int]) -> List[int]:
-        """Batch form of `_merge_global`: ONE collective merges a whole
-        warm pass's counts instead of a cross-process round-trip per
-        pair."""
         if not getattr(self._table, "process_local", False) or not counts:
             return list(counts)
         from delphi_tpu.parallel.distributed import (allgather_max,
                                                      process_count)
         global _lower_bound_logged
         if not _lower_bound_logged and process_count() > 1:
-            # one-time trace marker: multi-process distinct-pair counts are
-            # a max-over-shards LOWER BOUND, so candidate selection can
-            # diverge from a single-process run of the same data
+            # one-time trace marker: degraded multi-process distinct-pair
+            # counts are a max-over-shards LOWER BOUND, so candidate
+            # selection can diverge from a single-process run of the data
             _lower_bound_logged = True
             _logger.info(
-                f"distinct-pair counts on {process_count()} processes use "
-                "the max-over-shards lower bound; functional-dependency "
-                "candidate selection may differ from a single-process run")
+                f"distinct-pair counts on {process_count()} processes fell "
+                "back to the max-over-shards lower bound (exact key-set "
+                "gather unavailable); functional-dependency candidate "
+                "selection may differ from a single-process run")
         return [int(c) for c in
                 allgather_max(np.asarray(counts, dtype=np.int64))]
+
+    def _merge_global_exact(self, keys_list: List[np.ndarray]) -> List[int]:
+        """EXACT cross-process merge of per-shard distinct-pair key sets:
+        one byte-gather of every shard's deduped fused keys per warm pass
+        (site ``freq.distinct_merge``, watchdogged through the guarded
+        collective plane), then a per-pair union — the true global
+        distinct count, replacing the old max-over-shards lower bound.
+        The fused keys are comparable across processes because sharded
+        ingestion unifies vocabularies before any shard encodes. On a
+        degraded gather (rank loss) this falls back to
+        :meth:`_merge_lower_bound` with its one-time log."""
+        if not getattr(self._table, "process_local", False) or not keys_list:
+            return [int(len(k)) for k in keys_list]
+        import pickle
+
+        from delphi_tpu.parallel.distributed import (allgather_host_bytes,
+                                                     process_count)
+        if process_count() <= 1:
+            return [int(len(k)) for k in keys_list]
+        payload = pickle.dumps(
+            [np.asarray(k, dtype=np.int64) for k in keys_list])
+        gathered = allgather_host_bytes(payload, site="freq.distinct_merge")
+        shards: List[List[np.ndarray]] = []
+        try:
+            for blob in gathered:
+                part = pickle.loads(blob)
+                if len(part) != len(keys_list):
+                    raise ValueError("shard key-list length mismatch")
+                shards.append(part)
+        except Exception:
+            shards = []
+        if len(shards) <= 1:
+            return self._merge_lower_bound(
+                [int(len(k)) for k in keys_list])
+        return [int(len(np.unique(
+                    np.concatenate([np.asarray(s[i], dtype=np.int64)
+                                    for s in shards]))))
+                for i in range(len(keys_list))]
 
     def warm(self, pairs) -> None:
         todo = []
@@ -380,12 +415,19 @@ class PairDistinctCounter:
                 todo.append((x, y))
         if len(todo) < 2 or self._global_rows() < (1 << 14):
             return  # host path is cheaper than a kernel launch
-        if jax.default_backend() == "cpu":
-            # the device kernel is an O(n log n) lexsort per pair — on the
-            # CPU backend the host's O(n) factorize hash pass wins ~7x
-            # (55s -> 8s for the hospital-scale pair-pruning sweep at 2M)
-            merged = self._merge_global_many(
-                [self._host_distinct_pair_count(x, y) for x, y in todo])
+        multi = getattr(self._table, "process_local", False)
+        if multi or jax.default_backend() == "cpu":
+            # host path: on the CPU backend the O(n) factorize hash pass
+            # beats the device's O(n log n) lexsort ~7x (55s -> 8s for the
+            # hospital-scale pair-pruning sweep at 2M); process-local
+            # shards ALWAYS come here because exactness needs the shard's
+            # key SET (not just its count) for the cross-process union
+            if multi:
+                merged = self._merge_global_exact(
+                    [self._host_distinct_pair_keys(x, y) for x, y in todo])
+            else:
+                merged = [self._host_distinct_pair_count(x, y)
+                          for x, y in todo]
             for (x, y), c in zip(todo, merged):
                 self._cache[frozenset((x, y))] = c
             return
@@ -418,15 +460,27 @@ class PairDistinctCounter:
                 "freq.distinct",
                 lambda c1=c1, c2=c2: _batched_distinct_pair_counts(c1, c2)))
             local_counts.extend(int(c) for c in counts[:len(chunk)])
-        for (x, y), c in zip(todo, self._merge_global_many(local_counts)):
+        # the device path only serves non-process-local tables (the branch
+        # above), so the per-shard counts ARE the global counts
+        for (x, y), c in zip(todo, local_counts):
             self._cache[frozenset((x, y))] = c
+
+    def _fused_pair_keys(self, x: str, y: str) -> np.ndarray:
+        cx = self._table.column(x)
+        cy = self._table.column(y)
+        return (cx.codes.astype(np.int64) + 1) * (cy.domain_size + 1) \
+            + (cy.codes.astype(np.int64) + 1)
+
+    def _host_distinct_pair_keys(self, x: str, y: str) -> np.ndarray:
+        """This shard's DEDUPED fused (x, y) keys — the exact-merge wire
+        format (`_merge_global_exact` unions these across shards)."""
+        return np.unique(self._fused_pair_keys(x, y))
 
     def _host_distinct_pair_count(self, x: str, y: str) -> int:
         import pandas as pd
         cx = self._table.column(x)
         cy = self._table.column(y)
-        fused = (cx.codes.astype(np.int64) + 1) * (cy.domain_size + 1) \
-            + (cy.codes.astype(np.int64) + 1)
+        fused = self._fused_pair_keys(x, y)
         dense = (cx.domain_size + 1) * (cy.domain_size + 1)
         if dense <= 1 << 26:
             # small value space: a dense bincount is pure indexed adds —
@@ -439,8 +493,11 @@ class PairDistinctCounter:
     def distinct_pair_count(self, x: str, y: str) -> int:
         key = frozenset((x, y))
         if key not in self._cache:
-            self._cache[key] = self._merge_global(
-                self._host_distinct_pair_count(x, y))
+            if getattr(self._table, "process_local", False):
+                self._cache[key] = self._merge_global_exact(
+                    [self._host_distinct_pair_keys(x, y)])[0]
+            else:
+                self._cache[key] = self._host_distinct_pair_count(x, y)
         return self._cache[key]
 
 

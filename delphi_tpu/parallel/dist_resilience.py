@@ -182,27 +182,30 @@ def report_section() -> Optional[Dict[str, Any]]:
 
 
 # -- liveness files ----------------------------------------------------------
+# The primitives below take EXPLICIT paths/directories so any membership
+# domain can reuse them: a jax.distributed cluster keys members by rank
+# (this module's own env-driven wrappers), and the serve fleet keys them by
+# worker id (observability/fleet.py points scan_membership at its fleet
+# dir). One file format, one staleness rule, two consumers.
 
 _toucher: Dict[str, Any] = {"thread": None, "stop": None}
 
-
-def _liveness_path(rank: int) -> Optional[str]:
-    d = liveness_dir()
-    return os.path.join(d, f"rank_{int(rank)}.alive") if d else None
+_LIVENESS_PREFIX = "rank_"
+_LIVENESS_SUFFIX = ".alive"
 
 
-def touch_liveness() -> None:
-    """Writes this rank's liveness stamp (wall-clock seconds as text —
-    file CONTENT, not mtime, so the fake-clock tests and clock-skewed
-    hosts read one consistent timebase). Best-effort: liveness is
-    evidence, never a failure source."""
-    from delphi_tpu.parallel import distributed as dist
-    try:
-        path = _liveness_path(dist.process_index())
-    except Exception:
-        return
-    if not path:
-        return
+def member_liveness_path(directory: str, member) -> str:
+    """Liveness file for one member (a rank in a cluster, a worker id in
+    a serve fleet) under an explicit membership directory."""
+    return os.path.join(directory,
+                        f"{_LIVENESS_PREFIX}{member}{_LIVENESS_SUFFIX}")
+
+
+def touch_liveness_file(path: str) -> None:
+    """Stamps one liveness file (wall-clock seconds as text — file
+    CONTENT, not mtime, so fake-clock tests and clock-skewed hosts read
+    one consistent timebase). Best-effort: liveness is evidence, never a
+    failure source."""
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
@@ -213,19 +216,80 @@ def touch_liveness() -> None:
         _logger.warning(f"liveness touch failed: {e}")
 
 
-def peer_liveness_age_s(rank: int, now: Optional[float] = None
-                        ) -> Optional[float]:
-    """Seconds since ``rank`` last touched its liveness file, or None
-    when the seam is off / the rank never wrote one."""
-    path = _liveness_path(rank)
+def liveness_file_age_s(path: Optional[str],
+                        now: Optional[float] = None) -> Optional[float]:
+    """Seconds since the liveness file at ``path`` was stamped, or None
+    when the file is absent/unreadable (member never registered, or
+    already unregistered)."""
     if not path or not os.path.exists(path):
         return None
     try:
         with open(path) as f:
-            stamp = float(f.read().strip())
+            stamp = float(f.read().split()[0])
     except Exception:
         return None
     return max(0.0, (now if now is not None else float(_wall())) - stamp)
+
+
+def diagnose_liveness_file(path: Optional[str], interval_s: float,
+                           now: Optional[float] = None) -> str:
+    """Membership diagnosis for one liveness file: ``live`` (stamp
+    fresher than 3x the heartbeat interval), ``dead`` (stale stamp — the
+    member stopped touching it), or ``unknown`` (no file)."""
+    age = liveness_file_age_s(path, now=now)
+    if age is None:
+        return "unknown"
+    return "live" if age <= 3.0 * max(interval_s, 0.001) else "dead"
+
+
+def scan_membership(directory: str, interval_s: float,
+                    now: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
+    """Scans a membership directory for liveness files and returns
+    ``{member_id: {"age_s": float|None, "status": live|dead|unknown}}``.
+    The reusable membership reader: the fleet router derives its worker
+    ring from this, the same files the cluster's post-timeout peer
+    diagnosis reads."""
+    members: Dict[str, Dict[str, Any]] = {}
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return members
+    for name in sorted(entries):
+        if not (name.startswith(_LIVENESS_PREFIX)
+                and name.endswith(_LIVENESS_SUFFIX)):
+            continue
+        member = name[len(_LIVENESS_PREFIX):-len(_LIVENESS_SUFFIX)]
+        path = os.path.join(directory, name)
+        members[member] = {
+            "age_s": liveness_file_age_s(path, now=now),
+            "status": diagnose_liveness_file(path, interval_s, now=now),
+        }
+    return members
+
+
+def _liveness_path(rank: int) -> Optional[str]:
+    d = liveness_dir()
+    return member_liveness_path(d, int(rank)) if d else None
+
+
+def touch_liveness() -> None:
+    """Writes this rank's liveness stamp (see
+    :func:`touch_liveness_file`) under ``DELPHI_LIVENESS_DIR``."""
+    from delphi_tpu.parallel import distributed as dist
+    try:
+        path = _liveness_path(dist.process_index())
+    except Exception:
+        return
+    if not path:
+        return
+    touch_liveness_file(path)
+
+
+def peer_liveness_age_s(rank: int, now: Optional[float] = None
+                        ) -> Optional[float]:
+    """Seconds since ``rank`` last touched its liveness file, or None
+    when the seam is off / the rank never wrote one."""
+    return liveness_file_age_s(_liveness_path(rank), now=now)
 
 
 def diagnose_peer(rank: int, now: Optional[float] = None) -> str:

@@ -319,6 +319,8 @@ KNOWN_SITES = frozenset({
     "freq.pairs",
     "freq.pairs_pallas",
     "freq.distinct",
+    "freq.distinct_merge",
+    "fleet.dispatch",
     "domain.score",
     "domain.weak_label",
     "domain.bucket",

@@ -16,6 +16,13 @@
   deadline (rank_loss, single-host latch, per-rank report flagged
   ``aggregation_incomplete``) and still produce a frame bit-identical
   to a clean single-process run.
+- ``bench.fleet_chaos_smoke``: the elastic fleet A/B — a 2-worker
+  repair fleet behind the FleetRouter, one worker killed mid-traffic by
+  a rank-scoped ``rank_death`` plan; the router must evict the dead
+  worker and re-dispatch in-flight requests so EVERY submitted request
+  completes bit-identical to a clean single-server run (zero drops),
+  with ``fleet.evictions``/``fleet.redispatches`` fired and ``/healthz``
+  reporting ``degraded``.
 
 All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
@@ -37,7 +44,10 @@ def _clean_chaos_state():
               "DELPHI_RETRY_BASE_S", "DELPHI_COMPILE_CACHE_MIN_S",
               "DELPHI_COMPILE_CACHE_DIR", "DELPHI_MESH",
               "DELPHI_COLLECTIVE_TIMEOUT_S", "DELPHI_HEARTBEAT_S",
-              "DELPHI_LIVENESS_DIR", "DELPHI_CHECKPOINT_DIR")}
+              "DELPHI_LIVENESS_DIR", "DELPHI_CHECKPOINT_DIR",
+              "DELPHI_FLEET_DIR", "DELPHI_FLEET_WORKER_ID",
+              "DELPHI_FLEET_HEARTBEAT_S", "DELPHI_FLEET_WORKERS",
+              "DELPHI_FLEET_MAX_HOPS", "DELPHI_FLEET_SPAWN_TIMEOUT_S")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
@@ -64,3 +74,7 @@ def test_serve_chaos_concurrent_isolation():
 
 def test_dist_chaos_survivor_bit_identical():
     assert bench.dist_chaos_smoke() == 0
+
+
+def test_fleet_chaos_failover_bit_identical():
+    assert bench.fleet_chaos_smoke() == 0

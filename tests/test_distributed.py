@@ -242,6 +242,90 @@ def test_two_process_distributed_smoke(tmp_path):
     assert "DIST_SMOKE_OK" in outs[0]
 
 
+_DISTINCT_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
+os.environ["DELPHI_NUM_PROCESSES"] = "2"
+os.environ["DELPHI_PROCESS_ID"] = sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+assert maybe_initialize_distributed()
+assert jax.process_count() == 2
+
+from delphi_tpu.ingest import read_csv_encoded_sharded
+from delphi_tpu.ops.freq import PairDistinctCounter
+
+local = read_csv_encoded_sharded(os.environ["CSV"], "tid", chunksize=2)
+assert local.process_local and local.n_rows == 4, local.n_rows
+got = PairDistinctCounter(local).distinct_pair_count("x", "y")
+expect = int(os.environ["EXPECT"])
+assert got == expect, f"rank {jax.process_index()}: {got} != {expect}"
+print("DISTINCT_PARITY_OK", flush=True)
+"""
+
+
+def test_two_process_distinct_pair_single_process_parity(tmp_path):
+    """The sharded distinct-pair count is EXACT on a real 2-process
+    cluster: the shards are built so their pair sets overlap in exactly
+    one pair — the global distinct (3) exceeds every per-shard count (2),
+    so the old max-over-shards lower bound would return 2 and only the
+    key-set-union merge matches the single-process answer on BOTH
+    ranks."""
+    import pandas as pd
+
+    # chunksize=2 round-robin: rank 0 gets rows 0-1 and 4-5 (pairs
+    # {(a,p), (b,q)}), rank 1 gets rows 2-3 and 6-7 ({(a,p), (c,r)})
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(8)],
+        "x": ["a", "b", "a", "c", "a", "b", "a", "c"],
+        "y": ["p", "q", "p", "r", "p", "q", "p", "r"],
+    })
+    csv = tmp_path / "distinct_input.csv"
+    df.to_csv(csv, index=False)
+    expect = len(set(zip(df["x"], df["y"])))
+    assert expect == 3  # > 2, every shard's local distinct count
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "distinct_worker.py"
+    worker.write_text(_DISTINCT_WORKER)
+    repo = str(Path(__file__).resolve().parents[1])
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DELPHI_MESH")}
+    env["COORD"] = f"127.0.0.1:{port}"
+    env["CSV"] = str(csv)
+    env["EXPECT"] = str(expect)
+    env["REPO"] = repo
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i)], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert "DISTINCT_PARITY_OK" in out
+
+
 _SHARDED_WORKER = r"""
 import os, sys
 sys.path.insert(0, os.environ["REPO"])

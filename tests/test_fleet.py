@@ -1,0 +1,312 @@
+"""FleetRouter edge cases, in-process against scripted fake workers:
+
+- rendezvous ranking is stable under member removal (the warm-state
+  affinity property the fleet leans on);
+- every ``fleet.*`` counter is pre-seeded at zero on the router's
+  /metrics before any traffic;
+- all-shed: when EVERY live worker sheds (429-rejected), the router
+  returns 429 with the MAX observed Retry-After, hits each worker at
+  most once, and never loops;
+- a worker dying between the membership check and the dispatch is a
+  ``fleet.dispatch`` fault: evicted, the in-flight request re-dispatched
+  to the survivor, /healthz degrades;
+- affinity: the same payload keeps landing on its rendezvous-home
+  worker;
+- a stale liveness stamp evicts; a re-touched one rejoins.
+
+The full-stack A/B (real spawned workers, one killed mid-traffic,
+bit-identity vs a clean single-server run) lives in
+bench.fleet_chaos_smoke and is exercised by tests/test_chaos_ab.py.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from delphi_tpu.observability.fleet import FleetRouter, rendezvous_rank
+from delphi_tpu.observability.serve import table_fingerprint
+from delphi_tpu.parallel import dist_resilience as dr
+from delphi_tpu.parallel import resilience as rz
+
+_ENV_VARS = (
+    "DELPHI_FAULT_PLAN", "DELPHI_FLEET_DIR", "DELPHI_FLEET_WORKER_ID",
+    "DELPHI_FLEET_HEARTBEAT_S", "DELPHI_FLEET_WORKERS",
+    "DELPHI_FLEET_MAX_HOPS", "DELPHI_FLEET_SPAWN_TIMEOUT_S",
+    "DELPHI_SERVE_CACHE_DIR",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_state():
+    saved = {v: os.environ.get(v) for v in _ENV_VARS}
+    for v in _ENV_VARS:
+        os.environ.pop(v, None)
+    rz.reset_fault_state()
+    rz.clear_abort()
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+    rz.reset_fault_state()
+    rz.clear_abort()
+
+
+def _payload(tag="t0"):
+    return {"table": {"tid": ["1", "2"], "c0": [tag, tag]}, "row_id": "tid"}
+
+
+class _ScriptedWorker:
+    """An in-process HTTP 'worker' answering /repair from a script:
+    ``respond(payload) -> (status, body_dict, headers_dict)``."""
+
+    def __init__(self, respond):
+        self.respond = respond
+        self.requests = []
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                outer.requests.append(payload)
+                status, body, headers = outer.respond(payload)
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _register(fleet_dir, wid, port):
+    """Fake a worker registration + fresh liveness stamp, the exact
+    on-disk shape serve.RepairServer._register_fleet_worker writes."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = os.path.join(fleet_dir, f"worker_{wid}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump({"worker_id": wid, "port": port, "pid": os.getpid(),
+                   "cache_dir": "", "started": 0.0}, f)
+    os.replace(path + ".tmp", path)
+    dr.touch_liveness_file(dr.member_liveness_path(fleet_dir, wid))
+
+
+def _counters(router):
+    return router.recorder.registry.snapshot()["counters"]
+
+
+def _closed_port():
+    """A port nothing listens on (bound then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def router(tmp_path):
+    rt = FleetRouter(port=0, workers=2, cache_dir=str(tmp_path),
+                     spawn=False, heartbeat_s=1.0)
+    yield rt
+    rt.stop()
+
+
+# -- rendezvous ---------------------------------------------------------------
+
+def test_rendezvous_rank_is_stable_under_member_removal():
+    members = [str(i) for i in range(5)]
+    for fp in ("a", "b", "c", "deadbeef"):
+        full = rendezvous_rank(fp, members)
+        for gone in members:
+            survivors = [m for m in members if m != gone]
+            # removing ONE member never reorders the survivors
+            assert rendezvous_rank(fp, survivors) == [
+                m for m in full if m != gone]
+
+
+# -- metrics / health surfaces ------------------------------------------------
+
+def test_fleet_counters_preseeded_at_zero(router, tmp_path):
+    w = _ScriptedWorker(lambda p: (200, {"status": "ok"}, {}))
+    try:
+        _register(router.fleet_dir, "0", w.port)
+        router.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+        for name in ("delphi_fleet_requests", "delphi_fleet_dispatches",
+                     "delphi_fleet_redispatches", "delphi_fleet_evictions",
+                     "delphi_fleet_rejoins", "delphi_fleet_dispatch_faults",
+                     "delphi_fleet_all_shed", "delphi_fleet_no_workers",
+                     "delphi_fleet_affinity_hits",
+                     "delphi_fleet_affinity_misses"):
+            lines = [ln for ln in metrics.splitlines()
+                     if ln.startswith(name + " ")]
+            assert lines, f"{name} not pre-seeded on router /metrics"
+            assert float(lines[0].split()[1]) == 0.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["live"] == ["0"]
+    finally:
+        w.close()
+
+
+# -- failover edge cases ------------------------------------------------------
+
+def test_all_shed_returns_429_with_max_retry_after(router):
+    """Every live worker shedding must terminate in ONE bounded pass:
+    each worker dispatched at most once, 429 out, Retry-After = the MAX
+    the fleet quoted (retrying sooner would just get shed again)."""
+    shed_a = _ScriptedWorker(
+        lambda p: (429, {"status": "rejected", "reason": "queue full"},
+                   {"Retry-After": "3"}))
+    shed_b = _ScriptedWorker(
+        lambda p: (429, {"status": "rejected", "reason": "queue full"},
+                   {"Retry-After": "7"}))
+    try:
+        _register(router.fleet_dir, "0", shed_a.port)
+        _register(router.fleet_dir, "1", shed_b.port)
+        router.start()
+        status, body, retry_after = router.handle_repair(_payload())
+        assert status == 429
+        assert body["status"] == "rejected"
+        assert retry_after == 7.0
+        assert len(shed_a.requests) == 1
+        assert len(shed_b.requests) == 1
+        snap = _counters(router)
+        assert snap.get("fleet.all_shed", 0) == 1
+        assert snap.get("fleet.evictions", 0) == 0  # shedding != broken
+    finally:
+        shed_a.close()
+        shed_b.close()
+
+
+def test_dead_worker_is_evicted_and_request_rerouted(router):
+    """A worker dying between the membership check and the dispatch
+    (fresh liveness stamp, nothing listening on its port) is a
+    fleet.dispatch fault: evicted, liveness dropped, the in-flight
+    request re-dispatched to the survivor — and /healthz degrades."""
+    ok = _ScriptedWorker(
+        lambda p: (200, {"status": "ok", "frame": [{"v": 1}]}, {}))
+    try:
+        payload = _payload()
+        fp = table_fingerprint(payload["table"], payload["row_id"])
+        victim = rendezvous_rank(fp, ["0", "1"])[0]
+        survivor = "1" if victim == "0" else "0"
+        # the request's rendezvous HOME gets a dead port, so the first
+        # dispatch always hits the corpse
+        _register(router.fleet_dir, victim, _closed_port())
+        _register(router.fleet_dir, survivor, ok.port)
+        router.start()
+
+        status, body, _ = router.handle_repair(payload)
+        assert status == 200
+        assert body["frame"] == [{"v": 1}]
+        assert len(ok.requests) == 1
+
+        snap = _counters(router)
+        assert snap.get("fleet.dispatch_faults", 0) == 1
+        assert snap.get("fleet.evictions", 0) == 1
+        assert snap.get("fleet.redispatches", 0) == 1
+        # anti-flapping: the corpse's stale stamp was dropped with it
+        assert not os.path.exists(
+            dr.member_liveness_path(router.fleet_dir, victim))
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "degraded"
+        assert victim in health["evicted"]
+        assert health["live"] == [survivor]
+    finally:
+        ok.close()
+
+
+def test_affinity_same_payload_keeps_its_home_worker(router):
+    """Repeated repairs of one table must keep landing on its
+    rendezvous-home replica — that is where the warm state lives."""
+    workers = {
+        "0": _ScriptedWorker(lambda p: (200, {"status": "ok"}, {})),
+        "1": _ScriptedWorker(lambda p: (200, {"status": "ok"}, {})),
+    }
+    try:
+        for wid, w in workers.items():
+            _register(router.fleet_dir, wid, w.port)
+        router.start()
+        payload = _payload()
+        fp = table_fingerprint(payload["table"], payload["row_id"])
+        home = rendezvous_rank(fp, ["0", "1"])[0]
+        for _ in range(3):
+            status, _, _ = router.handle_repair(payload)
+            assert status == 200
+        assert len(workers[home].requests) == 3
+        other = "1" if home == "0" else "0"
+        assert len(workers[other].requests) == 0
+        snap = _counters(router)
+        assert snap.get("fleet.affinity.hits", 0) == 3
+        assert snap.get("fleet.affinity.misses", 0) == 0
+    finally:
+        for w in workers.values():
+            w.close()
+
+
+# -- membership from liveness files -------------------------------------------
+
+def test_stale_liveness_evicts_and_retouch_rejoins(router):
+    _register(router.fleet_dir, "0", 1)
+    _register(router.fleet_dir, "1", 2)
+    router.start()
+    now = time.time()
+    assert sorted(router.refresh_membership(now=now)) == ["0", "1"]
+
+    # worker 1's stamp goes stale (> 3x heartbeat): evicted, not departed
+    assert router.refresh_membership(now=now + 100.0) == []
+    snap = _counters(router)
+    assert snap.get("fleet.evictions", 0) == 2
+    with router._lock:
+        assert set(router._evicted) == {"0", "1"}
+
+    # a fresh stamp rejoins the ring without operator action; worker 0's
+    # stamp is rewritten genuinely stale so only 1 comes back
+    with open(dr.member_liveness_path(router.fleet_dir, "0"), "w") as f:
+        f.write(repr(time.time() - 100.0))
+    dr.touch_liveness_file(dr.member_liveness_path(router.fleet_dir, "1"))
+    live = router.refresh_membership(now=time.time())
+    assert live == ["1"]
+    snap = _counters(router)
+    assert snap.get("fleet.rejoins", 0) == 1
+
+    # a worker whose REGISTRATION disappears departed cleanly: dropped
+    # from the ring AND the evicted set, no extra eviction counted
+    os.remove(os.path.join(router.fleet_dir, "worker_0.json"))
+    router.refresh_membership(now=time.time())
+    with router._lock:
+        assert "0" not in router._workers
+        assert "0" not in router._evicted
+    assert _counters(router).get("fleet.evictions", 0) == 2

@@ -462,8 +462,12 @@ def test_known_sites_match_source_literals():
             re.compile(r'guarded_collective\(\s*\n?\s*"([^"]+)"'),
             # collective sites threaded as defaulted keywords
             # (distributed.py's `site="dist.allgather_bytes"` idiom)
-            re.compile(r'site(?::\s*str)?\s*=\s*"([^"]+)"'))
-    found = {"backend.init"}  # injected by probe_backend, not run_guarded
+            re.compile(r'site(?::\s*str)?\s*=\s*"([^"]+)"'),
+            # injection-only seams (probe_backend's "backend.init", the
+            # fleet router's "fleet.dispatch"): chaos-injectable without
+            # the retry ladder, so the site literal rides _maybe_inject
+            re.compile(r'_maybe_inject\(\s*\n?\s*"([^"]+)"'))
+    found = set()
     for path in root.rglob("*.py"):
         text = path.read_text()
         for pat in pats:

@@ -27,6 +27,7 @@ _ENV_VARS = (
     "DELPHI_SERVE_DEADLINE_S", "DELPHI_SERVE_MAX_RSS_GB",
     "DELPHI_SERVE_STALL_SHED_S", "DELPHI_SERVE_CACHE_DIR",
     "DELPHI_SERVE_PROVENANCE_DIR", "DELPHI_COMPILE_CACHE_DIR",
+    "DELPHI_FLEET_DIR", "DELPHI_FLEET_WORKER_ID", "DELPHI_FLEET_HEARTBEAT_S",
 )
 
 
@@ -263,6 +264,79 @@ def test_concurrent_escalating_request_is_isolated():
     finally:
         srv.stop()
         shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+# -- fleet membership seam ----------------------------------------------------
+
+def test_fleet_registration_and_liveness_lifecycle(tmp_path):
+    """A fleet-armed worker announces itself on start (atomic
+    registration file carrying the bound ephemeral port, plus a
+    heartbeat-refreshed liveness file the dist-resilience scan reads)
+    and removes both on stop."""
+    from delphi_tpu.parallel import dist_resilience as dr
+
+    fleet_dir = str(tmp_path / "fleet")
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_test_")
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir, fleet_dir=fleet_dir,
+                       worker_id="7").start()
+    reg_path = os.path.join(fleet_dir, "worker_7.json")
+    live_path = dr.member_liveness_path(fleet_dir, "7")
+    try:
+        with open(reg_path) as f:
+            reg = json.load(f)
+        assert reg["worker_id"] == "7"
+        assert reg["port"] == srv.port
+        assert reg["pid"] == os.getpid()
+        assert reg["cache_dir"] == cache_dir
+        members = dr.scan_membership(fleet_dir, srv.fleet_heartbeat_s)
+        assert members["7"]["status"] == "live"
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    assert not os.path.exists(reg_path)
+    assert not os.path.exists(live_path)
+    leftover = [t.name for t in threading.enumerate()
+                if t.name.startswith("delphi-fleet-heartbeat")]
+    assert leftover == []
+
+
+def test_drain_unregisters_membership_before_closing_admission(tmp_path):
+    """Ordering contract the fleet leans on: a draining worker must drop
+    OUT of the membership ring (unregister liveness) BEFORE it closes
+    admission — the router stops routing there ahead of the first 503,
+    so a graceful drain never bounces requests off a worker the
+    membership scan still calls live."""
+    from delphi_tpu.parallel import dist_resilience as dr
+
+    fleet_dir = str(tmp_path / "fleet")
+    srv = RepairServer(workers=1, queue_depth=4,
+                       fleet_dir=fleet_dir, worker_id="3")
+    # registration normally rides start(); invoke it directly so the
+    # ordering is observable without the full HTTP stack
+    srv._register_fleet_worker()
+    live_path = dr.member_liveness_path(fleet_dir, "3")
+    assert os.path.exists(live_path)
+
+    calls = []
+    real_unregister = srv.unregister_fleet_worker
+
+    def spy():
+        calls.append(("unregister", srv._draining))
+        real_unregister()
+
+    srv.unregister_fleet_worker = spy
+    srv.begin_drain()
+    # membership exit fired exactly once, while admission was still open
+    assert calls == [("unregister", False)]
+    assert srv._draining is True
+    assert not os.path.exists(live_path)
+    with pytest.raises(Rejection) as ei:
+        srv.submit(_payload())
+    assert ei.value.status == 503
+    # a second drain is a no-op: the spy must not fire again
+    srv.begin_drain()
+    assert len(calls) == 1
 
 
 def test_drain_completes_in_flight_request():

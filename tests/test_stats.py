@@ -209,6 +209,98 @@ def test_pair_distinct_counter_chunked_warm(monkeypatch):
     assert {p: warmed.distinct_pair_count(*p) for p in pairs} == expect
 
 
+class _StubColumn:
+    def __init__(self, codes, domain_size):
+        self.codes = np.asarray(codes, dtype=np.int32)
+        self.domain_size = int(domain_size)
+
+
+class _StubShard:
+    """The minimal table surface PairDistinctCounter touches, with
+    process_local=True so the cross-process merge path is exercised
+    without a real 2-process launch (test_distributed has that)."""
+
+    process_local = True
+
+    def __init__(self, cols):
+        self._cols = cols
+        self.n_rows = len(next(iter(cols.values())).codes)
+
+    def column(self, name):
+        return self._cols[name]
+
+
+def _two_shards():
+    # shard 0 holds pairs {(0,0), (1,1)}, shard 1 holds {(0,0), (2,2)}:
+    # the exact global distinct is 3, but every per-shard count is 2 — so
+    # the old max-over-shards merge undercounts and the exact merge must
+    # not
+    shard0 = _StubShard({"x": _StubColumn([0, 1], 3),
+                         "y": _StubColumn([0, 1], 3)})
+    shard1 = _StubShard({"x": _StubColumn([0, 2], 3),
+                         "y": _StubColumn([0, 2], 3)})
+    return shard0, shard1
+
+
+def test_distinct_pair_exact_merge_across_shards(monkeypatch):
+    """The sharded distinct-pair merge is EXACT: a 2-rank key-set gather
+    unions per-shard pair sets, matching the single-process count over
+    the concatenated data (the old lower bound could not)."""
+    import pickle
+
+    import delphi_tpu.ops.freq as freq_mod
+    from delphi_tpu.parallel import distributed as dist
+
+    shard0, shard1 = _two_shards()
+    c0 = freq_mod.PairDistinctCounter(shard0)
+    c1 = freq_mod.PairDistinctCounter(shard1)
+    payloads = [pickle.dumps([c._host_distinct_pair_keys("x", "y")])
+                for c in (c0, c1)]
+    sites = []
+
+    def fake_gather(payload, site="dist.allgather_bytes"):
+        sites.append(site)
+        return list(payloads)
+
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "allgather_host_bytes", fake_gather)
+
+    # single-process ground truth over the concatenated shards
+    whole = _StubShard({"x": _StubColumn([0, 1, 0, 2], 3),
+                        "y": _StubColumn([0, 1, 0, 2], 3)})
+    whole.process_local = False
+    expect = freq_mod.PairDistinctCounter(whole).distinct_pair_count("x", "y")
+    assert expect == 3
+
+    assert c0.distinct_pair_count("x", "y") == expect
+    assert c1.distinct_pair_count("x", "y") == expect
+    # strictly better than max-over-shards (2), and through the
+    # registered guarded-collective site
+    assert sites == ["freq.distinct_merge", "freq.distinct_merge"]
+
+
+def test_distinct_pair_degraded_gather_uses_lower_bound(monkeypatch):
+    """When the key-set gather degrades (rank loss latched the
+    collectives), the merge falls back to the documented max-over-shards
+    lower bound and fires the one-time log marker."""
+    import delphi_tpu.ops.freq as freq_mod
+    from delphi_tpu.parallel import distributed as dist
+
+    shard0, _ = _two_shards()
+    c0 = freq_mod.PairDistinctCounter(shard0)
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    # degraded gather: only this process's payload comes back
+    monkeypatch.setattr(dist, "allgather_host_bytes",
+                        lambda payload, site="dist.allgather_bytes":
+                        [payload])
+    # degraded max: the local value survives
+    monkeypatch.setattr(dist, "allgather_max", lambda arr: arr)
+    monkeypatch.setattr(freq_mod, "_lower_bound_logged", False)
+
+    assert c0.distinct_pair_count("x", "y") == 2  # the shard-local bound
+    assert freq_mod._lower_bound_logged
+
+
 def test_weak_label_mask_matches_domain_top_value():
     """compute_weak_label_mask must demote exactly the cells whose top
     domain value (as compute_domain_in_error_cells orders it) equals the

@@ -148,3 +148,48 @@ def test_collective_allowlist_is_minimal():
     parallel_dir = OPS_DIR.parent / "parallel"
     for name in _COLLECTIVE_ALLOWED:
         assert (parallel_dir / name).is_file()
+
+
+def test_fleet_dispatch_routes_through_guarded_helper():
+    """Every router->worker HTTP call in observability/fleet.py must live
+    inside FleetRouter._dispatch_once — the ONE dispatch seam (site
+    ``fleet.dispatch``: chaos-injectable, abort-aware, and the place the
+    eviction/re-dispatch failover keys off). A urlopen added anywhere
+    else in the router would dodge fault injection AND the DispatchFault
+    classification the fleet chaos A/B certifies."""
+    import ast
+
+    src = (OPS_DIR.parent / "observability" / "fleet.py").read_text()
+    tree = ast.parse(src)
+    spans = [(node.lineno, node.end_lineno)
+             for node in ast.walk(tree)
+             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and node.name == "_dispatch_once"]
+    assert spans, "FleetRouter._dispatch_once disappeared from fleet.py"
+
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) \
+            else getattr(fn, "id", "")
+        if name == "urlopen" and not any(
+                lo <= node.lineno <= (hi or lo) for lo, hi in spans):
+            offenders.append(node.lineno)
+    assert not offenders, (
+        "router->worker HTTP outside the FleetRouter._dispatch_once seam "
+        f"(fleet.py lines {offenders}): route it through the guarded "
+        "helper so fault injection and eviction/re-dispatch cover it")
+
+    # the seam itself must stay chaos-injectable at its registered site
+    assert '_maybe_inject("fleet.dispatch")' in src, (
+        "FleetRouter._dispatch_once no longer injects at the "
+        "fleet.dispatch site")
+
+
+def test_fleet_and_distinct_sites_are_registered():
+    from delphi_tpu.parallel.resilience import KNOWN_SITES
+
+    assert "fleet.dispatch" in KNOWN_SITES
+    assert "freq.distinct_merge" in KNOWN_SITES
