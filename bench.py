@@ -330,6 +330,9 @@ def smoke() -> int:
     rc = transfer_smoke(df)
     if rc:
         return rc
+    rc = plan_smoke(df)
+    if rc:
+        return rc
     rc = chaos_smoke(df)
     if rc:
         return rc
@@ -535,6 +538,131 @@ def chaos_smoke(df=None) -> int:
               file=sys.stderr)
         return 1
     return 0
+
+
+def plan_smoke(df) -> int:
+    """Unified launch planner A/B: the same tiny repair with DELPHI_PLAN=0
+    (legacy per-phase grouping, no merging, no persistence) vs the planner
+    default, asserting bit-identical output frames, `launch.launches` on
+    the planner side <= the legacy side, and pad-waste accounted in the run
+    report. A third warm run against the SAME plan store must load every
+    persisted plan (plan_cache hits, zero replans) and record
+    compile_cache.hits > 0 against the plan-derived prewarm grid."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.session import get_session
+
+    plan_dir = tempfile.mkdtemp(prefix="delphi_plan_store_")
+
+    def one_run(tag: str, env: dict) -> dict:
+        _heartbeat(f"plan smoke {tag} run")
+        os.environ["DELPHI_DEVICE_TABLE"] = "1"
+        os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+        os.environ.update(env)
+        # same table name on every run: the table-level plan fingerprint
+        # derives from it, and the warm run must land on the cold run's
+        # persisted plans
+        name = "plan_smoke"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.plan.{tag}")
+        t0 = time.perf_counter()
+        try:
+            out = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+            del os.environ["DELPHI_DEVICE_TABLE"]
+            del os.environ["DELPHI_DOMAIN_DEVICE"]
+            for k in env:
+                os.environ.pop(k, None)
+        snap = rec.registry.snapshot()
+        counters = snap["counters"]
+        return {
+            "launches": int(counters.get("launch.launches", 0)),
+            "buckets": int(counters.get("launch.buckets", 0)),
+            "padded_units": int(counters.get("launch.padded_units", 0)),
+            "useful_units": int(counters.get("launch.useful_units", 0)),
+            "pad_waste_ratio": snap["gauges"].get("launch.pad_waste_ratio"),
+            "plan_cache_hits": int(
+                counters.get("launch.plan_cache.hits", 0)),
+            "replans": int(counters.get("launch.replans", 0)),
+            "compile_hits": int(counters.get("compile_cache.hits", 0)),
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "frame": out.sort_values(list(out.columns))
+            .reset_index(drop=True),
+        }
+
+    legacy = one_run("legacy", {"DELPHI_PLAN": "0"})
+    cold = one_run("cold", {"DELPHI_PLAN_DIR": plan_dir,
+                            "DELPHI_PREWARM": "1"})
+    # drop in-memory executables: warm compiles must come back from the
+    # persistent compile cache, and plans from the persisted store
+    jax.clear_caches()
+    warm = one_run("warm", {"DELPHI_PLAN_DIR": plan_dir,
+                            "DELPHI_PREWARM": "1"})
+
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(legacy["frame"], cold["frame"])
+        pd.testing.assert_frame_equal(legacy["frame"], warm["frame"])
+    except AssertionError:
+        frames_equal = False
+    for r in (legacy, cold, warm):
+        del r["frame"]
+
+    from delphi_tpu.parallel import planner
+    stored = planner.PlanStore(plan_dir)
+    stored_phases = sorted(
+        p for fp in stored.fingerprints()
+        for p in stored._doc(fp).get("phases", {}))
+
+    ok = frames_equal \
+        and cold["launches"] <= legacy["launches"] \
+        and cold["launches"] > 0 \
+        and cold["useful_units"] > 0 \
+        and cold["pad_waste_ratio"] is not None \
+        and cold["replans"] > 0 \
+        and warm["plan_cache_hits"] > 0 \
+        and warm["replans"] == 0 \
+        and warm["compile_hits"] > 0
+    print(json.dumps({
+        "metric": "plan_smoke",
+        "value": legacy["launches"] - cold["launches"],
+        "unit": "launches saved", "vs_baseline": None, "ok": ok,
+        "frames_equal": frames_equal, "stored_phases": stored_phases,
+        "legacy": legacy, "cold": cold, "warm": warm,
+    }), flush=True)
+    shutil.rmtree(plan_dir, ignore_errors=True)
+    if not ok:
+        print("plan smoke FAILED: planner A/B did not hold (frames, launch "
+              "count, pad-waste accounting, or warm plan/compile reuse)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def plan() -> int:
+    """Standalone `bench.py --plan-smoke` entry: CPU backend, planner
+    on/off/warm A/B (see plan_smoke)."""
+    import tempfile
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_DIR",
+                          tempfile.mkdtemp(prefix="delphi_plan_cc_"))
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_MIN_S", "0")
+    _force_cpu_backend()
+    from delphi_tpu.observability import live
+    live._install_compile_listener()
+    return plan_smoke(_smoke_frame())
 
 
 def chaos() -> int:
@@ -1736,6 +1864,15 @@ def main() -> None:
                         help="tiny in-process CPU double-run asserting the "
                              "warm run records compile_cache.hits > 0; "
                              "exits 1 on failure")
+    parser.add_argument("--plan-smoke", dest="plan_smoke",
+                        action="store_true",
+                        help="unified launch planner A/B on the CPU backend: "
+                             "the smoke frame with DELPHI_PLAN=0 vs the "
+                             "planner default plus a warm rerun against the "
+                             "persisted plan store, asserting bit-identical "
+                             "frames, launches <= legacy, pad-waste "
+                             "accounting, and warm plan/compile-cache "
+                             "reuse; exits 1 on failure")
     parser.add_argument("--chaos", action="store_true",
                         help="resilience A/B on the CPU backend: repairs the "
                              "smoke frame fault-free and under a "
@@ -1792,6 +1929,9 @@ def main() -> None:
 
     if args.smoke:
         sys.exit(smoke())
+
+    if args.plan_smoke:
+        sys.exit(plan())
 
     if args.chaos:
         sys.exit(chaos())

@@ -54,11 +54,6 @@ class JointProposal:
         self.belief = belief
 
 
-def _pow2(n: int, floor: int = 1) -> int:
-    v = max(int(n), floor)
-    return 1 << (v - 1).bit_length()
-
-
 def _log_cond(pair_uv: np.ndarray, single_u: np.ndarray,
               v_size: int) -> np.ndarray:
     """log P(v | u) with Laplace smoothing; ``pair_uv`` is [U+1, V+1] raw
@@ -101,16 +96,26 @@ def run_joint_tier(masked: Any, cells: List[RoutedCell],
         by_row.setdefault(c.row_pos, []).append(i)
 
     # bucket by padded domain size so one compiled executable serves every
-    # attribute whose vocabulary lands in the same power-of-two band
-    buckets: Dict[int, List[int]] = {}
-    for i, c in enumerate(todo):
-        buckets.setdefault(
-            _pow2(name_to_col[c.attribute].domain_size), []).append(i)
+    # attribute whose vocabulary lands in the same power-of-two band — the
+    # grouping comes from the unified launch planner. The v_pad axis is the
+    # piece SHAPE (never merged: the softmax reduction order over the
+    # domain axis must stay per-vocabulary-band); only the cell batch axis
+    # is planner-padded.
+    from delphi_tpu.parallel import planner
+    plan = planner.plan_launches(
+        "escalation.joint",
+        [planner.Piece(
+            key=i, size=1,
+            shape=(planner.pow2_pad(name_to_col[c.attribute].domain_size),))
+         for i, c in enumerate(todo)],
+        pad_batch=True, persist=False)
+    plan.record()
 
     proposals: List[JointProposal] = []
-    for v_pad in sorted(buckets):
-        members = buckets[v_pad]
-        n_pad = _pow2(len(members))
+    for launch in sorted(plan.launches, key=lambda l: l.shape[0]):
+        v_pad = int(launch.shape[0])
+        members = [span.key for span in launch.spans]
+        n_pad = launch.batch_pad
         unary = np.full((n_pad, v_pad), NEG_INF, dtype=np.float32)
         unary[:, 0] = 0.0  # padded rows: a defined softmax, discarded below
         nbr_idx = np.full((n_pad, NBR_CAP), -1, dtype=np.int32)

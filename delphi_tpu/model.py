@@ -13,6 +13,7 @@ encoded-table kernels instead of Spark SQL + LightGBM:
 DataFrames in and out are pandas.
 """
 
+import contextlib
 import copy
 import hashlib
 import heapq
@@ -2629,6 +2630,23 @@ class RepairModel:
             "mode": (selected[0] if selected else "repair_candidates"),
         })
 
+        # launch-plan fingerprint: the serve plane scopes requests to its
+        # own request fingerprint; outside serve, a table-level one makes
+        # plan persistence work for bench/CLI runs when a plan store is
+        # armed (DELPHI_PLAN_DIR). Collisions are harmless — the plan
+        # signature re-validates the piece set on load.
+        from delphi_tpu.parallel import planner
+        if planner.current_fingerprint() is None \
+                and planner.get_plan_store() is not None:
+            plan_scope = planner.plan_fingerprint(
+                planner.table_plan_fingerprint(
+                    input_name, table.n_rows,
+                    [c.name for c in table.columns]))
+        else:
+            plan_scope = contextlib.nullcontext()
+        stack = contextlib.ExitStack()
+        stack.enter_context(plan_scope)
+
         # compile plane: cache config + AOT shape-grid prewarm start here,
         # so the training variants compile in the background while error
         # detection and domain analysis still run
@@ -2658,6 +2676,7 @@ class RepairModel:
                         table, input_name, continuous_columns,
                         *run_flags)
         finally:
+            stack.close()
             if prewarm is not None:
                 prewarm.stop()
         _logger.info(f"!!!Total Processing time is {elapsed}(s)!!!")

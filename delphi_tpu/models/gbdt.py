@@ -257,8 +257,10 @@ _GOOD_ENOUGH_F1 = 0.995
 
 
 def _round_chunks(n_rounds: int) -> List[int]:
-    q, r = divmod(max(int(n_rounds), 1), _CHUNK_ROUNDS)
-    return [_CHUNK_ROUNDS] * q + ([r] if r else [])
+    # boost-chunk policy lives in the unified launch planner (two compiled
+    # variants max: the fixed chunk plus one remainder)
+    from delphi_tpu.parallel import planner
+    return planner.round_chunks(n_rounds, _CHUNK_ROUNDS)
 
 
 _BOOST_STATIC = ("n_rounds", "depth", "n_bins", "n_nodes", "objective", "k",
@@ -822,7 +824,7 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                    tuple(cfg_idx))
             merged.setdefault(key, []).append(t)
 
-    for key, t_members in merged.items():
+    for gi_group, (key, t_members) in enumerate(merged.items()):
         if timed_out:
             break
         (g_depth, g_rounds, n_pad, d_pad, n_bins, objective, k,
@@ -874,9 +876,24 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                            + [place(init_F(t, j), F_spec_m)])
             slabs = None
         else:
-            cap = max(1, int(os.environ.get("DELPHI_CV_INSTANCE_CAP",
-                                            str(_CV_INSTANCE_CAP))))
-            slabs = [inst[i:i + cap] for i in range(0, len(inst), cap)]
+            # slab split + width via the unified launch planner; the plan
+            # persists per table fingerprint so the compile plane prewarms
+            # exactly the (width, shape) variants a warm request launches.
+            # DELPHI_PLAN_CV_INSTANCE_CAP is the cap knob (legacy
+            # DELPHI_CV_INSTANCE_CAP spelling honored with a warning).
+            from delphi_tpu.parallel import planner
+            cap = planner.cv_instance_cap(default=_CV_INSTANCE_CAP)
+            slab_plan = planner.plan_launches(
+                f"gbdt.cv[{gi_group}]",
+                [planner.Piece(key=i, size=1,
+                               shape=(g_depth, g_rounds, n_pad, d_pad,
+                                      n_bins, objective, k, n_cfg))
+                 for i in range(len(inst))],
+                batch_cap=cap, pad_batch=(T > 1))
+            slab_plan.record()
+            slabs = [[inst[span.key] for span in launch.spans]
+                     for launch in slab_plan.launches]
+            slab_widths = [launch.batch_pad for launch in slab_plan.launches]
 
             def stack_pad(arrs, W, fill, dtype=None):
                 out = np.stack([np.asarray(a) for a in arrs])
@@ -889,14 +906,12 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                 return jnp.asarray(out)
 
             slab_data = []
-            for slab in slabs:
+            for slab, W in zip(slabs, slab_widths):
                 # multi-target slabs pad the instance axis to a power of
                 # two (few compiled width variants; dummy all-zero-weight
                 # rows are cheap relative to a fresh compile); the
                 # single-target search keeps its exact fold count — its
                 # width never varies, so padding would only waste FLOPs
-                W = len(slab) if T == 1 \
-                    else 1 << max(0, len(slab) - 1).bit_length()
                 skey = tuple(slab)
                 if skey not in slab_static_cache:
                     es = [preps[t]["instances"][j] for (t, j) in slab]

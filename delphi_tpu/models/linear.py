@@ -44,8 +44,10 @@ def _pad_cols(X: np.ndarray) -> np.ndarray:
     """Pads the feature axis to the next power of two so the per-attribute
     training loop reuses one compiled program across one-hot widths; padded
     columns are all-zero, so their weights only see the L2 pull and stay 0."""
+    from delphi_tpu.parallel import planner
+
     d = X.shape[1]
-    target = max(8, 1 << (d - 1).bit_length())
+    target = planner.pow2_pad(d, floor=8)
     if target == d:
         return X
     return np.concatenate(
@@ -322,7 +324,8 @@ class LogisticRegressionModel:
                 [gid, np.zeros((n, fc_pad - fc), np.int32)], axis=1)
         fmask = (np.arange(fc_pad) < fc).astype(np.float32)
         v = int(sizes.sum())
-        v_pad = max(16, 1 << (v - 1).bit_length())
+        from delphi_tpu.parallel import planner
+        v_pad = planner.pow2_pad(v, floor=16)
         cont = _pad_cols(X.cont) if X.cont.shape[1] else \
             np.zeros((n, 8), np.float32)
         gid_p, (yp, cont_p), mask = _pad_rows(gid, codes.astype(np.int32),

@@ -450,7 +450,8 @@ def _size_bucket(size: int) -> str:
     """Power-of-two domain-size buckets: "0", "1", "2-3", "4-7", ..."""
     if size <= 0:
         return "0"
-    lo = 1 << (int(size).bit_length() - 1)
+    from delphi_tpu.parallel.planner import pow2_floor
+    lo = pow2_floor(size)
     hi = lo * 2 - 1
     return str(lo) if hi == lo else f"{lo}-{hi}"
 

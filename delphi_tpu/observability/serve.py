@@ -133,6 +133,9 @@ _SEED_COUNTERS = (
     "escalation.joint.proposals", "escalation.joint.repairs",
     "escalation.adapter.calls", "escalation.adapter.repairs",
     "escalation.adapter.call_budget_exhausted",
+    "launch.plans", "launch.launches", "launch.buckets", "launch.pieces",
+    "launch.padded_units", "launch.useful_units", "launch.merged_buckets",
+    "launch.plan_cache.hits", "launch.replans",
 )
 
 
@@ -288,6 +291,11 @@ class RepairServer:
                 and not os.environ.get("DELPHI_XLA_CACHE_DIR"):
             os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(
                 self.cache_dir, "compile")
+        # arm the launch-plan store next to it: plans persist per table
+        # fingerprint, so a warm request skips replanning and the compile
+        # plane prewarms exactly the variants the stored plan will launch
+        from delphi_tpu.parallel import planner
+        planner.set_plan_store(os.path.join(self.cache_dir, "plans"))
         # one long-lived recorder for the server's whole life: per-request
         # model.run() recorders nest into it (start_recording returns None
         # when one is active), so every request's metrics land in ONE
@@ -403,8 +411,15 @@ class RepairServer:
                 return 0
         models = _count("models")
         ckpts = _count("ckpt")
+        try:
+            plans = len([e for e in os.listdir(
+                os.path.join(self.cache_dir, "plans"))
+                if e.endswith(".json")])
+        except OSError:
+            plans = 0
         gauge_set("serve.warm_models", models)
         gauge_set("serve.warm_checkpoints", ckpts)
+        gauge_set("serve.warm_plans", plans)
         if models or ckpts:
             _logger.info(f"warm-state rebuild: {models} model checkpoint "
                          f"dir(s), {ckpts} phase-checkpoint dir(s) under "
@@ -684,8 +699,10 @@ class RepairServer:
                 rid, fault_plan=str(payload.get("fault_plan") or ""),
                 deadline_s=rem, checkpoint_dir=self._ckpt_dir(fp))
             job.scope = scope
+            from delphi_tpu.parallel import planner
             with resilience.request_scope(scope), \
-                    provenance.scoped_ledger(ledger):
+                    provenance.scoped_ledger(ledger), \
+                    planner.plan_fingerprint(fp):
                 out = model.run()
             # canonical response ordering: sorted by all columns, so two
             # servers (or a solo run) repairing the same table respond

@@ -114,8 +114,12 @@ def kmeans(X: np.ndarray, k: int, seed: int = 0, n_iters: int = 20) -> np.ndarra
             centers.append(X[rng.choice(n, p=d / total)])
     init = to_device(np.stack(centers))
     # pad rows to the next power of two so subcluster splits of varying
-    # sizes reuse one compiled program per (bucket, k)
-    target = max(8, 1 << (n - 1).bit_length())
+    # sizes reuse one compiled program per (bucket, k) — sizing comes from
+    # the unified launch planner (padded rows are masked out of every
+    # centroid update, so the bucket size is numerics-inert)
+    from delphi_tpu.parallel import planner
+    target = planner.padded_extent(
+        "cluster", n, floor=8, shape=(int(k), int(n_iters), int(X.shape[1])))
     Xp = X if target == n else np.concatenate(
         [X, np.zeros((target - n,) + X.shape[1:], X.dtype)], axis=0)
     mask = np.concatenate(

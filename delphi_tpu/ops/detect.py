@@ -154,11 +154,20 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
     fences = {}
     device_pools = [p for p in pools if _use_device_detect(len(p[4]))]
     if device_pools:
-        longest = max(len(p[4]) for p in device_pools)
-        padded = np.full((len(device_pools), longest), np.nan,
+        # batch layout via the unified planner: one shape bucket, every
+        # pool padded to the longest (NaN fill is nanpercentile-inert)
+        from delphi_tpu.parallel import planner
+        plan = planner.plan_launches(
+            "detect.percentile",
+            [planner.Piece(key=i, size=len(p[4]))
+             for i, p in enumerate(device_pools)],
+            pad_to_max=True, persist=False)
+        plan.record()
+        launch = plan.launches[0]
+        padded = np.full((len(device_pools), launch.padded_size), np.nan,
                          dtype=np.float64)
-        for i, (_, _, _, _, pool) in enumerate(device_pools):
-            padded[i, :len(pool)] = pool
+        for span in launch.spans:
+            padded[span.key, :span.size] = device_pools[span.key][4]
         qs = _guarded_percentile_batch(padded)
         if qs is not None:
             for i, (attr, _, _, _, _) in enumerate(device_pools):
@@ -377,8 +386,13 @@ def _use_device_detect(n: int) -> bool:
 
 
 def _pad_pow2(arr, fill):
+    # registered legacy shim over the unified launch planner: the padded
+    # extent (and its launch.* accounting) comes from planner.padded_extent;
+    # this helper only materializes the fill values
+    from delphi_tpu.parallel import planner
+
     n = len(arr)
-    target = max(8, 1 << (max(n, 1) - 1).bit_length())
+    target = planner.padded_extent("detect", n, floor=8)
     if target == n:
         return arr
     return np.concatenate([arr, np.full(target - n, fill, arr.dtype)])
@@ -522,9 +536,10 @@ def _device_group_extrema(values: np.ndarray, groups: np.ndarray,
     # padding rows route to an extra scratch segment; the segment count is a
     # STATIC jit arg, so it rounds to the next power of two (variants stay
     # log2-bounded like the row padding) and the result slices back down
+    from delphi_tpu.parallel import planner
     v = _pad_pow2(values.astype(np.float64), np.nan)
     g = _pad_pow2(groups.astype(np.int64), n_groups)
-    seg_pad = max(8, 1 << (max(n_groups + 1, 1) - 1).bit_length())
+    seg_pad = planner.pow2_pad(n_groups + 1, floor=8)
     with enable_x64():
         out = np.asarray(resilience.run_guarded(
             "detect.group_extrema",

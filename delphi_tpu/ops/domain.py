@@ -306,7 +306,8 @@ def compute_weak_label_mask(
             plan[gi] = "fused" if g_fused else "int"
             jobs.append((gi, group, ranks[gi][1] if g_fused else None,
                          g_fused))
-        bucket_results = _bucketed_run(table, jobs, beta=beta) if jobs \
+        bucket_results = _bucketed_run(
+            table, jobs, beta=beta, phase="domain.weak") if jobs \
             else {}
     else:
         bucket_results = {}
@@ -406,7 +407,11 @@ def _jit_score_kernel():
 
 
 def _chunk_cells() -> int:
-    return max(1, int(os.environ.get("DELPHI_DOMAIN_CHUNK_CELLS", "1000000")))
+    # unified planner knob (DELPHI_PLAN_CHUNK_CELLS; the legacy
+    # DELPHI_DOMAIN_CHUNK_CELLS spelling is honored with a deprecation
+    # warning)
+    from delphi_tpu.parallel import planner
+    return planner.chunk_cells(default=1_000_000)
 
 
 def _pad_chunk_operands(codes_chunk, pair_tables, taus, has_single,
@@ -426,8 +431,9 @@ def _pad_chunk_operands(codes_chunk, pair_tables, taus, has_single,
     n_pad = -(-cells // 65536) * 65536
 
     if "tables" not in operand_cache:
+        from delphi_tpu.parallel import planner
         vc_max = max(int(t.shape[0]) for t in pair_tables)
-        vc_pad = max(8, 1 << (vc_max - 1).bit_length())
+        vc_pad = planner.pow2_pad(vc_max, floor=8)
         tables = np.zeros((k, vc_pad, va_pad + 1), np.int32)
         for i, t in enumerate(pair_tables):
             tables[i, :t.shape[0], :t.shape[1]] = t
@@ -653,12 +659,14 @@ def _prep_group_operands(group, vocab_rank=None) -> dict:
     the SAME padding rules as _pad_chunk_operands, so the bucketed fused
     kernel reduces over an identical va_pad axis to the legacy fused route
     and the integer route's exact accumulators line up slot for slot."""
+    from delphi_tpu.parallel import planner
+
     pair_tables, taus, corr_cols, has_single, n = group._ctx
     k = len(corr_cols)
     v_a = int(has_single.shape[0])
     va_pad = -(-v_a // 32) * 32
     vc_max = max(int(t.shape[0]) for t in pair_tables)
-    vc_pad = max(8, 1 << (vc_max - 1).bit_length())
+    vc_pad = planner.pow2_pad(vc_max, floor=8)
     tables = np.zeros((k, vc_pad, va_pad + 1), np.int32)
     for i, t in enumerate(pair_tables):
         tables[i, :t.shape[0], :t.shape[1]] = t
@@ -737,7 +745,7 @@ def _jit_bucket_kernel(fused: bool):
     return kernel
 
 
-def _bucketed_run(table, jobs, beta=None):
+def _bucketed_run(table, jobs, beta=None, phase="domain.scores"):
     """Runs every (group, chunk) piece of ``jobs`` through shape-bucketed
     batched launches against the device-resident code matrix.
 
@@ -764,29 +772,43 @@ def _bucketed_run(table, jobs, beta=None):
     codes_state = {"cols": cols, "all_codes": _stack_all_codes(cols)}
     sentinel = int(cols[0].codes.shape[0]) if cols else 0
 
+    from delphi_tpu.parallel import planner
+
     chunk = _chunk_cells()
     out = {j[0]: [] for j in jobs}
-    buckets: Dict[tuple, list] = {}
+    ctx: Dict[int, tuple] = {}
+    pieces = []
     for gi, g, rank, fused in jobs:
         prep = _prep_group_operands(g, rank)
         cidx = np.asarray([col_slot[id(c)] for c in g._ctx[2]], np.int32)
-        for lo in range(0, len(g.rows), chunk):
-            sub = np.asarray(g.rows[lo:lo + chunk], np.int64)
-            rows_pad = max(_BUCKET_MIN_ROWS,
-                           1 << max(len(sub) - 1, 0).bit_length())
-            key = (fused, prep["k"], prep["va_pad"], prep["vc_pad"],
-                   rows_pad)
-            buckets.setdefault(key, []).append((gi, lo, sub, prep, cidx))
+        ctx[gi] = (g, prep, cidx)
+        pieces.append(planner.Piece(
+            key=gi, size=len(g.rows),
+            shape=(bool(fused), prep["k"], prep["va_pad"], prep["vc_pad"])))
 
-    for (fused, k, va_pad, vc_pad, rows_pad), pieces in buckets.items():
+    def bucket_cap(shape, rows_pad):
         # launch budget: cells bounded by the legacy chunk size, table
         # duplication bounded separately (wide-vocab groups)
+        _, k, va_pad, vc_pad = shape
         per_tables = k * vc_pad * (va_pad + 1)
-        b_max = max(1, min(chunk // max(rows_pad, 1),
-                           _BUCKET_TABLE_ELEMS // max(per_tables, 1)))
-        for s in range(0, len(pieces), b_max):
-            _launch_bucket(pieces[s:s + b_max], fused, k, va_pad, vc_pad,
-                           rows_pad, codes_state, sentinel, beta, out)
+        return max(1, min(chunk // max(rows_pad, 1),
+                          _BUCKET_TABLE_ELEMS // max(per_tables, 1)))
+
+    plan = planner.plan_launches(
+        phase, pieces, size_floor=_BUCKET_MIN_ROWS, chunk=chunk,
+        batch_cap=bucket_cap, pad_batch=True, merge=True,
+        policy_tag=f"elems={_BUCKET_TABLE_ELEMS}")
+    plan.record()
+
+    for launch in plan.launches:
+        fused, k, va_pad, vc_pad = launch.shape
+        batch = []
+        for span in launch.spans:
+            g, prep, cidx = ctx[span.key]
+            sub = np.asarray(g.rows[span.lo:span.lo + span.size], np.int64)
+            batch.append((span.key, span.lo, sub, prep, cidx))
+        _launch_bucket(batch, fused, k, va_pad, vc_pad, launch.padded_size,
+                       codes_state, sentinel, beta, out)
     for gi in out:
         out[gi].sort(key=lambda t: t[0])
     return out
@@ -826,8 +848,12 @@ def _launch_bucket(batch, fused, k, va_pad, vc_pad, rows_pad, codes_state,
 def _launch_bucket_once(batch, fused, k, va_pad, vc_pad, rows_pad,
                         codes_state, sentinel, beta, out):
     global _bucket_kernel_int, _bucket_kernel_fused
+    from delphi_tpu.parallel import planner
+
+    # b_pad recomputes here (not read off the plan) because the resilience
+    # plane's ShrinkBatch rung can halve the batch below the planned width
     b = len(batch)
-    b_pad = 1 << (b - 1).bit_length()
+    b_pad = planner.pow2_pad(b)
     col_idx = np.zeros((b_pad, k), np.int32)
     taus = np.zeros((b_pad, k), np.int32)
     hs = np.zeros((b_pad, va_pad), np.int32)

@@ -86,15 +86,36 @@ def compute_pairwise_stats(
     target_attrs = list(dict.fromkeys(a for p in target_attr_pairs for a in p))
     assert all(a in domain_stats for a in target_attrs)
 
-    # H(x,y) per unordered pair
-    h_xy: Dict[frozenset, float] = {}
+    # H(x,y) per unordered pair — dispatch routed through the unified
+    # launch planner: one "entropy" plan whose buckets are the pallas-vs-
+    # host routes (each entry is an independent reduction, so grouping is
+    # pure bookkeeping and the math is untouched)
+    from delphi_tpu.parallel import planner
+
+    uniq: List[Tuple[str, str]] = []
+    seen = set()
     for x, y in target_attr_pairs:
         key = frozenset((x, y))
-        if key in h_xy:
-            continue
-        m = freq.pair(x, y)
-        h_xy[key] = _entropy_with_correction(
-            m.ravel(), n_rows, int(domain_stats[x]) * int(domain_stats[y]))
+        if key not in seen:
+            seen.add(key)
+            uniq.append((x, y))
+    mats = [freq.pair(x, y).ravel() for x, y in uniq]
+    plan = planner.plan_launches(
+        "entropy",
+        [planner.Piece(
+            key=i, size=int(m.size),
+            shape=("pallas" if _use_pallas_entropy(m.size, n_rows)
+                   else "host",))
+         for i, m in enumerate(mats)],
+        persist=False)
+    plan.record()
+    h_xy: Dict[frozenset, float] = {}
+    for launch in plan.launches:
+        for span in launch.spans:
+            x, y = uniq[span.key]
+            h_xy[frozenset((x, y))] = _entropy_with_correction(
+                mats[span.key], n_rows,
+                int(domain_stats[x]) * int(domain_stats[y]))
 
     # H(y) per attr
     h_y: Dict[str, float] = {}

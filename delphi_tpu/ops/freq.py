@@ -264,11 +264,19 @@ def compute_freq_stats(table: EncodedTable,
         stride = v_pad + 1
         # The vmapped kernel materializes a [pairs, rows] fused-key buffer;
         # bound it to ~1 GB per launch so 10M+-row tables don't blow device
-        # memory when many candidate pairs arrive at once.
+        # memory when many candidate pairs arrive at once. Grouping comes
+        # from the unified planner (DELPHI_PAIR_BUDGET is the cap knob).
+        from delphi_tpu.parallel import planner
         per_launch = max(1,
                          int(_pair_keys_per_launch() // max(table.n_rows, 1)))
-        for s in range(0, len(xla_pairs), per_launch):
-            group = xla_pairs[s:s + per_launch]
+        pair_plan = planner.plan_launches(
+            "freq.pairs",
+            [planner.Piece(key=i, size=1, shape=(v_pad, int(table.n_rows)))
+             for i in range(len(xla_pairs))],
+            batch_cap=per_launch, persist=False)
+        pair_plan.record()
+        for launch in pair_plan.launches:
+            group = [xla_pairs[span.key] for span in launch.spans]
             # one [2, P] upload instead of two separate index vectors
             xy = xfer.to_device(np.asarray(
                 [[name_to_idx[x] for x, _ in group],
@@ -432,18 +440,26 @@ class PairDistinctCounter:
                 self._cache[frozenset((x, y))] = c
             return
         # Bound the [chunk, rows] code stacks (x2 attrs + lexsort workspace)
-        # to ~1 GB regardless of table size.
+        # to ~1 GB regardless of table size — the launch width and batching
+        # come from the unified planner (fixed batch_width: short tails pad
+        # by repeating the last pair so every launch shares one compiled
+        # shape; duplicates are discarded).
         from delphi_tpu.ops import xfer
+        from delphi_tpu.parallel import planner
         chunk_size = max(1, min(self._WARM_CHUNK,
                                 int(_pair_keys_per_launch()
                                     // self._table.n_rows)))
+        plan = planner.plan_launches(
+            "freq.distinct",
+            [planner.Piece(key=i, size=1, shape=(int(self._table.n_rows),))
+             for i in range(len(todo))],
+            batch_width=chunk_size, persist=False)
+        plan.record()
         resident = xfer.device_table_enabled()
-        local_counts = []
-        for s in range(0, len(todo), chunk_size):
-            chunk = todo[s:s + chunk_size]
-            # pad short chunks by repeating the last pair so every launch
-            # shares one compiled (batch) shape; duplicates are discarded
-            padded = chunk + [chunk[-1]] * (chunk_size - len(chunk))
+        local_counts = [0] * len(todo)
+        for launch in plan.launches:
+            chunk = [todo[span.key] for span in launch.spans]
+            padded = chunk + [chunk[-1]] * (launch.batch_pad - len(chunk))
             if resident:
                 # device-side stacks over the once-uploaded column buffers
                 c1 = jnp.stack([xfer.device_codes(self._table.column(x))
@@ -459,7 +475,8 @@ class PairDistinctCounter:
             counts = np.asarray(run_guarded(
                 "freq.distinct",
                 lambda c1=c1, c2=c2: _batched_distinct_pair_counts(c1, c2)))
-            local_counts.extend(int(c) for c in counts[:len(chunk)])
+            for span, c in zip(launch.spans, counts[:len(chunk)]):
+                local_counts[span.key] = int(c)
         # the device path only serves non-process-local tables (the branch
         # above), so the per-shard counts ARE the global counts
         for (x, y), c in zip(todo, local_counts):

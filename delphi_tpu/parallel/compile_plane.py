@@ -327,11 +327,45 @@ def plan_prewarm_variants(table: Any, continuous_columns: List[str],
     domain = table.domain_stats()
     continuous = set(continuous_columns)
 
+    from delphi_tpu.parallel import planner
+
     n_splits = int(get_option_value(opts, *_train._opt_n_splits))
     max_evals = int(get_option_value(opts, *_train._opt_max_evals))
     n_train = max(1, min(n_rows, int(max_training_rows)))
     if n_train < n_splits * 2:
         return []  # no CV search at this size, nothing to warm
+
+    # Plan-derived grid: when a persisted launch plan exists for this
+    # table fingerprint, prewarm EXACTLY the (shape, width) variants its
+    # gbdt.cv launches will request — no heuristics, no wasted compiles.
+    stored = planner.stored_launch_shapes(
+        planner.current_fingerprint(), "gbdt.cv")
+    if stored:
+        variants = []
+        seen = set()
+        for shape, _padded, width in stored:
+            try:
+                (depth, rounds, s_n_pad, s_d_pad, s_n_bins, objective, k,
+                 n_cfg) = shape
+            except ValueError:
+                continue  # stored by an older layout; fall back below
+            for chunk in sorted(set(planner.round_chunks(
+                    int(rounds), _gbdt._CHUNK_ROUNDS))):
+                vkey = (chunk, int(depth), objective, int(k), int(width),
+                        int(n_cfg), int(s_n_pad), int(s_d_pad))
+                if vkey in seen:
+                    continue
+                seen.add(vkey)
+                variants.append(dict(
+                    chunk=chunk, depth=int(depth), n_bins=int(s_n_bins),
+                    n_nodes=1 << int(depth), objective=objective, k=int(k),
+                    width=int(width), n_cfg=int(n_cfg), n_pad=int(s_n_pad),
+                    d_pad=int(s_d_pad)))
+        if variants:
+            budget = _prewarm_budget()
+            if len(variants) > budget:
+                variants = variants[:budget]
+            return variants
     n_pad = _gbdt.train_row_target(n_train, None)
     # feature estimate: one feature column per non-target attribute (the
     # compact GBDT design); a miss only wastes one warmed variant
@@ -378,21 +412,15 @@ def plan_prewarm_variants(table: Any, continuous_columns: List[str],
             depth = int(cfg.get("max_depth", 7))
             rounds = _gbdt._cfg_rounds_for(cfg, objective, k)
             groups[(depth, rounds)] = groups.get((depth, rounds), 0) + 1
-        # slab widths the search will launch: single targets keep their
-        # exact fold count, multi-target slabs pad to powers of two under
-        # the instance cap (see gbdt_cv_grid_search_multi)
+        # slab widths the search will launch: derived from the SAME
+        # planner policy gbdt_cv_grid_search_multi uses (single targets
+        # keep their exact fold count, multi-target slabs pad to powers of
+        # two under the instance cap), so the grid cannot drift from the
+        # real dispatch
         total = n_targets * n_splits
-        cap = int(os.environ.get("DELPHI_CV_INSTANCE_CAP",
-                                 str(_gbdt._CV_INSTANCE_CAP)))
-        widths = set()
-        if n_targets == 1:
-            widths.add(n_splits)
-        else:
-            full, rem = divmod(total, cap)
-            if full:
-                widths.add(cap)
-            if rem:
-                widths.add(1 << max(0, rem - 1).bit_length())
+        cap = planner.cv_instance_cap(default=_gbdt._CV_INSTANCE_CAP)
+        widths = set(planner.plan_cv_slab_widths(
+            total, cap, single_target=n_targets == 1))
         for (depth, _rounds), n_cfg in groups.items():
             for width in sorted(widths):
                 vkey = (depth, objective, k, width, n_cfg)

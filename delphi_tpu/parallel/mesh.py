@@ -288,7 +288,8 @@ def padded_row_target(n: int, mesh: Optional[Mesh], axis: str = "dp") -> int:
     bound), raised to a multiple of the mesh's dp size so row shards are
     equal. dp sizes that are powers of two (the normal case) leave the
     power-of-two target unchanged."""
-    target = max(8, 1 << (max(n, 1) - 1).bit_length())
+    from delphi_tpu.parallel import planner
+    target = planner.pow2_pad(n, floor=8)
     if mesh is not None:
         dp = mesh.shape[axis]
         target = ((target + dp - 1) // dp) * dp

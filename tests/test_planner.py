@@ -1,0 +1,296 @@
+"""Property tests for the unified launch planner (parallel/planner.py).
+
+The planner is the ONE dispatch policy every device phase routes through
+(tests/test_transfer_guard.py enforces the routing statically); these tests
+pin the semantics the call sites rely on: exact piece coverage, pow2
+padding, deterministic plans, a merge that never increases launch count,
+signature-validated persistence, and the legacy formulas the migrated
+policies (GBDT round chunks, CV slab widths) must keep matching.
+"""
+
+import json
+import warnings
+
+import pytest
+
+from delphi_tpu.parallel import planner
+from delphi_tpu.parallel.planner import Piece
+
+
+@pytest.fixture(autouse=True)
+def _pristine_planner(monkeypatch):
+    # no armed store, no thread fingerprint, planner knobs at defaults
+    monkeypatch.setattr(planner, "_store", None)
+    monkeypatch.setattr(planner, "_env_store", None)
+    monkeypatch.delenv("DELPHI_PLAN", raising=False)
+    monkeypatch.delenv("DELPHI_PLAN_DIR", raising=False)
+    monkeypatch.delenv("DELPHI_PLAN_MERGE", raising=False)
+    monkeypatch.delenv("DELPHI_PLAN_CHUNK_CELLS", raising=False)
+    monkeypatch.delenv("DELPHI_PLAN_CV_INSTANCE_CAP", raising=False)
+    monkeypatch.delenv("DELPHI_DOMAIN_CHUNK_CELLS", raising=False)
+    monkeypatch.delenv("DELPHI_CV_INSTANCE_CAP", raising=False)
+    yield
+
+
+def _coverage(plan):
+    """{piece_key: sorted [lo, hi) spans} across every launch of the plan."""
+    cov = {}
+    for launch in plan.launches:
+        for s in launch.spans:
+            cov.setdefault(s.key, []).append((s.lo, s.lo + s.size))
+    return {k: sorted(v) for k, v in cov.items()}
+
+
+PIECES = [Piece(key=0, size=100, shape=("a",)),
+          Piece(key=1, size=7, shape=("a",)),
+          Piece(key=2, size=513, shape=("b", 4)),
+          Piece(key=3, size=1, shape=("a",)),
+          Piece(key=4, size=64, shape=("b", 4))]
+
+
+@pytest.mark.parametrize("kw", [
+    {},
+    {"chunk": 32},
+    {"chunk": 32, "batch_cap": 3, "pad_batch": True},
+    {"batch_cap": 2},
+    {"pad_to_max": True},
+    {"merge": True, "chunk": 16},
+    {"size_floor": 16, "chunk": 50},
+])
+def test_every_piece_covered_exactly_once(kw):
+    plan = planner.plan_launches("t.cover", PIECES, **kw)
+    cov = _coverage(plan)
+    assert set(cov) == {p.key for p in PIECES}
+    for p in PIECES:
+        spans = cov[p.key]
+        # contiguous, non-overlapping, and spanning exactly [0, size)
+        assert spans[0][0] == 0 and spans[-1][1] == p.size
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+
+def test_zero_size_pieces_are_dropped():
+    plan = planner.plan_launches(
+        "t.zero", [Piece(key=0, size=0), Piece(key=1, size=5)])
+    assert _coverage(plan) == {1: [(0, 5)]}
+
+
+def test_padded_sizes_are_pow2_and_floored():
+    plan = planner.plan_launches("t.pow2", PIECES, size_floor=16, chunk=100)
+    for launch in plan.launches:
+        p = launch.padded_size
+        assert p >= 16 and (p & (p - 1)) == 0
+        assert all(s.size <= p for s in launch.spans)
+
+
+def test_pad_batch_pow2s_the_batch_axis():
+    plan = planner.plan_launches(
+        "t.batch", [Piece(key=i, size=8) for i in range(5)],
+        batch_cap=3, pad_batch=True)
+    for launch in plan.launches:
+        b = launch.batch_pad
+        assert b >= len(launch.spans) and (b & (b - 1)) == 0
+    # without pad_batch the batch axis is exact
+    plan = planner.plan_launches(
+        "t.batch2", [Piece(key=i, size=8) for i in range(5)], batch_cap=3)
+    assert sorted(l.batch_pad for l in plan.launches) == [2, 3]
+
+
+def test_batch_width_fixes_cap_and_pad():
+    plan = planner.plan_launches(
+        "t.width", [Piece(key=i, size=1, shape=(64,)) for i in range(10)],
+        batch_width=4)
+    assert [len(l.spans) for l in plan.launches] == [4, 4, 2]
+    assert all(l.batch_pad == 4 for l in plan.launches)
+
+
+def test_pad_to_max_pads_each_shape_bucket_to_its_longest_span():
+    plan = planner.plan_launches(
+        "t.longest",
+        [Piece(key=0, size=9, shape=("p",)), Piece(key=1, size=33,
+                                                   shape=("p",)),
+         Piece(key=2, size=5, shape=("q",))],
+        pad_to_max=True)
+    by_shape = {l.shape: l.padded_size for l in plan.launches}
+    assert by_shape == {("p",): 33, ("q",): 5}
+
+
+def test_plans_are_deterministic():
+    a = planner.plan_launches("t.det", PIECES, chunk=32, batch_cap=3,
+                              pad_batch=True, merge=True)
+    b = planner.plan_launches("t.det", PIECES, chunk=32, batch_cap=3,
+                              pad_batch=True, merge=True)
+    assert a.signature == b.signature
+    assert a.launches == b.launches
+
+
+def test_merge_never_increases_launch_count():
+    pieces = [Piece(key=i, size=s)
+              for i, s in enumerate([3, 5, 9, 17, 33, 65, 100, 120, 128])]
+    for cap in (1, 2, 4, None):
+        merged = planner.plan_launches("t.merge", pieces, batch_cap=cap,
+                                       merge=True)
+        plain = planner.plan_launches("t.plain", pieces, batch_cap=cap)
+        assert merged.n_launches <= plain.n_launches
+        assert _coverage(merged) == _coverage(plain)
+        if cap is None:
+            # everything within the default x8 ratio folds into one launch
+            assert merged.merged_buckets > 0
+
+
+def test_plan_disabled_pins_legacy_grouping(monkeypatch):
+    merged = planner.plan_launches("t.ab", PIECES, merge=True)
+    monkeypatch.setenv("DELPHI_PLAN", "0")
+    legacy = planner.plan_launches("t.ab", PIECES, merge=True)
+    plain = planner.plan_launches("t.ab2", PIECES)
+    assert legacy.merged_buckets == 0
+    assert [(l.shape, l.padded_size, tuple(l.spans))
+            for l in legacy.launches] \
+        == [(l.shape, l.padded_size, tuple(l.spans))
+            for l in plain.launches]
+    # toggling the knob changes the signature, so a persisted merged plan
+    # can never be replayed by a DELPHI_PLAN=0 run
+    assert legacy.signature != merged.signature
+
+
+def test_pad_waste_accounting():
+    plan = planner.plan_launches(
+        "t.waste", [Piece(key=0, size=5), Piece(key=1, size=3)],
+        pad_batch=True, batch_cap=1)
+    assert plan.useful_units == 8
+    assert plan.padded_units == 8 + 4  # pow2 pads: 8 and 4
+    assert plan.pad_waste_ratio == pytest.approx(1 - 8 / 12)
+
+
+def test_persisted_plan_reloads_and_invalidates(tmp_path):
+    planner.set_plan_store(str(tmp_path))
+    try:
+        fp = "f" * 40
+        cold = planner.plan_launches("t.store", PIECES, fingerprint=fp)
+        assert not cold.cached
+        warm = planner.plan_launches("t.store", PIECES, fingerprint=fp)
+        assert warm.cached
+        assert warm.launches == cold.launches
+        # stored as pure data on disk
+        doc = json.loads((tmp_path / f"{fp}.json").read_text())
+        assert doc["phases"]["t.store"]["signature"] == cold.signature
+
+        # piece-set change invalidates: replan, store updated
+        changed = planner.plan_launches(
+            "t.store", PIECES + [Piece(key=9, size=11)], fingerprint=fp)
+        assert not changed.cached
+        again = planner.plan_launches(
+            "t.store", PIECES + [Piece(key=9, size=11)], fingerprint=fp)
+        assert again.cached and again.signature == changed.signature
+
+        # policy-knob change (tag) also invalidates
+        tagged = planner.plan_launches(
+            "t.store", PIECES + [Piece(key=9, size=11)], fingerprint=fp,
+            policy_tag="elems=2")
+        assert not tagged.cached
+    finally:
+        planner.set_plan_store(None)
+
+
+def test_persistence_requires_fingerprint_and_enabled(tmp_path, monkeypatch):
+    planner.set_plan_store(str(tmp_path))
+    try:
+        planner.plan_launches("t.nofp", PIECES)  # no fingerprint: no file
+        assert planner.get_plan_store().n_plans() == 0
+        monkeypatch.setenv("DELPHI_PLAN", "0")
+        planner.plan_launches("t.nofp", PIECES, fingerprint="a" * 40)
+        assert planner.get_plan_store().n_plans() == 0  # disabled: no file
+    finally:
+        planner.set_plan_store(None)
+
+
+def test_plan_fingerprint_scope_and_table_fingerprint(tmp_path):
+    planner.set_plan_store(str(tmp_path))
+    try:
+        fp = planner.table_plan_fingerprint("t", 64, ["a", "b"])
+        assert fp == planner.table_plan_fingerprint("t", 64, ["a", "b"])
+        assert fp != planner.table_plan_fingerprint("t", 65, ["a", "b"])
+        assert planner.current_fingerprint() is None
+        with planner.plan_fingerprint(fp):
+            assert planner.current_fingerprint() == fp
+            planner.plan_launches("t.scoped", PIECES)
+        assert planner.current_fingerprint() is None
+        assert planner.get_plan_store().load(fp, "t.scoped") is not None
+    finally:
+        planner.set_plan_store(None)
+
+
+def test_stored_launch_shapes_aggregates_subphases(tmp_path):
+    planner.set_plan_store(str(tmp_path))
+    try:
+        fp = "c" * 40
+        planner.plan_launches("gbdt.cv[0]",
+                              [Piece(key=0, size=1, shape=(6, 50))],
+                              fingerprint=fp)
+        planner.plan_launches("gbdt.cv[1]",
+                              [Piece(key=0, size=1, shape=(6, 80))],
+                              fingerprint=fp)
+        planner.plan_launches("domain.scores",
+                              [Piece(key=0, size=64)], fingerprint=fp)
+        shapes = planner.stored_launch_shapes(fp, "gbdt.cv")
+        assert {s[0] for s in shapes} == {(6, 50), (6, 80)}
+        assert planner.stored_launch_shapes(fp, "gbdt") == []
+        assert planner.stored_launch_shapes(None, "gbdt.cv") == []
+    finally:
+        planner.set_plan_store(None)
+
+
+def test_round_chunks_matches_legacy_formula():
+    for n, chunk in [(1, 50), (49, 50), (50, 50), (51, 50), (150, 50),
+                     (0, 50), (199, 64)]:
+        q, r = divmod(max(n, 1), chunk)
+        assert planner.round_chunks(n, chunk) == [chunk] * q + (
+            [r] if r else [])
+        assert sum(planner.round_chunks(n, chunk)) == max(n, 1)
+
+
+def test_cv_slab_widths_match_legacy_enumeration():
+    for total in (1, 3, 16, 17, 40):
+        for cap in (4, 16):
+            for single in (True, False):
+                widths = planner.plan_cv_slab_widths(total, cap, single)
+                legacy = set()
+                for lo in range(0, total, cap):
+                    n = min(cap, total - lo)
+                    legacy.add(n if single else planner.pow2_pad(n))
+                assert widths == sorted(legacy)
+    assert planner.plan_cv_slab_widths(0, 4, True) == []
+
+
+def test_deprecated_env_knobs_warn_once_and_lose(monkeypatch):
+    monkeypatch.setattr(planner, "_DEPRECATED_WARNED", set())
+    monkeypatch.setenv("DELPHI_DOMAIN_CHUNK_CELLS", "123")
+    with pytest.warns(DeprecationWarning, match="DELPHI_PLAN_CHUNK_CELLS"):
+        assert planner.chunk_cells() == 123
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # one-time: second read is silent
+        assert planner.chunk_cells() == 123
+    # the unified spelling wins over the deprecated one
+    monkeypatch.setenv("DELPHI_PLAN_CHUNK_CELLS", "456")
+    assert planner.chunk_cells() == 456
+
+    monkeypatch.setattr(planner, "_DEPRECATED_WARNED", set())
+    monkeypatch.setenv("DELPHI_CV_INSTANCE_CAP", "7")
+    with pytest.warns(DeprecationWarning,
+                      match="DELPHI_PLAN_CV_INSTANCE_CAP"):
+        assert planner.cv_instance_cap() == 7
+    monkeypatch.setenv("DELPHI_PLAN_CV_INSTANCE_CAP", "9")
+    assert planner.cv_instance_cap() == 9
+
+
+def test_pow2_helpers():
+    assert [planner.pow2_pad(n) for n in (0, 1, 2, 3, 7, 8, 9)] \
+        == [1, 1, 2, 4, 8, 8, 16]
+    assert planner.pow2_pad(3, floor=16) == 16
+    assert [planner.pow2_floor(n) for n in (1, 2, 3, 8, 9, 1023)] \
+        == [1, 2, 2, 8, 8, 512]
+
+
+def test_padded_extent_matches_pow2_pad():
+    for n in (1, 5, 8, 100):
+        assert planner.padded_extent("t.extent", n, floor=8) \
+            == planner.pow2_pad(n, floor=8)

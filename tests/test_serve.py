@@ -364,3 +364,64 @@ def test_drain_completes_in_flight_request():
     finally:
         srv.stop()
         shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _metric(metrics: str, name: str) -> float:
+    for line in metrics.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"{name} not on /metrics")
+
+
+def test_warm_restart_reuses_persisted_launch_plans():
+    """Launch-plan persistence across the serve plane: the first request
+    plans every device phase once and persists the plans under
+    ``<cache>/plans/<fingerprint>.json``; a server RESTARTED on the same
+    cache dir reports them via the ``serve.warm_plans`` gauge and a repeat
+    request with the same table fingerprint replans ZERO times — every
+    phase loads its stored grouping (``launch.plan_cache.hits``) instead
+    of recomputing it."""
+    cache_dir = tempfile.mkdtemp(prefix="delphi_serve_test_")
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        port = srv.port
+        status, resp, _ = _post(port, "/repair", _payload(request_id="cold"))
+        assert status == 200 and resp["status"] == "ok"
+        frame_cold = resp["frame"]
+
+        status, metrics = _get(port, "/metrics")
+        assert _metric(metrics, "delphi_launch_replans") > 0
+        assert _metric(metrics, "delphi_launch_plans") > 0
+        assert _metric(metrics, "delphi_serve_warm_plans") >= 1
+
+        plans_dir = os.path.join(cache_dir, "plans")
+        stored = [f for f in os.listdir(plans_dir) if f.endswith(".json")]
+        assert stored, "no plan file persisted under <cache>/plans"
+    finally:
+        srv.stop()
+
+    # warm restart on the same cache dir: plans survive the process-state
+    # loss (the in-memory table cache does not, so the model really reruns).
+    # Drop the phase checkpoints so the rerun actually computes — a
+    # checkpoint resume would skip the planned phases and this test would
+    # vacuously pass on replans == 0.
+    shutil.rmtree(os.path.join(cache_dir, "ckpt"), ignore_errors=True)
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        port = srv.port
+        status, metrics = _get(port, "/metrics")
+        assert _metric(metrics, "delphi_serve_warm_plans") >= 1
+
+        status, resp, _ = _post(port, "/repair", _payload(request_id="warm"))
+        assert status == 200 and resp["status"] == "ok"
+        assert resp["frame"] == frame_cold
+
+        status, metrics = _get(port, "/metrics")
+        assert _metric(metrics, "delphi_serve_table_cache_hits") == 0
+        assert _metric(metrics, "delphi_launch_plan_cache_hits") > 0
+        assert _metric(metrics, "delphi_launch_replans") == 0
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)

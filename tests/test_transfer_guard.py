@@ -193,3 +193,66 @@ def test_fleet_and_distinct_sites_are_registered():
 
     assert "fleet.dispatch" in KNOWN_SITES
     assert "freq.distinct_merge" in KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# Launch-planning seam: every pad/bucket/chunk decision in the pipeline must
+# route through parallel/planner.py (plan_launches / padded_extent /
+# pow2_pad), or the unified LaunchPlan — and everything keyed off it:
+# pad-waste accounting, plan persistence, the plan-derived prewarm grid —
+# silently stops covering that phase. An ad-hoc `bit_length` pow2 pad or a
+# private bucketing loop added anywhere else is exactly the drift this
+# guard exists to fail.
+
+# shims that are allowed to keep a pow2-pad NAME for back-compat, provided
+# they delegate to the planner (checked below); currently none carry their
+# own bit_length arithmetic
+_PLANNER_SHIMS: set = set()
+
+# the modules whose dispatch policies were folded into the planner; each
+# must keep referencing it (wholesale-removal guard, mirroring
+# test_launch_modules_reference_the_resilience_seam)
+_PLANNED_MODULES = (
+    "ops/domain.py", "ops/cluster.py", "ops/freq.py", "ops/entropy.py",
+    "ops/detect.py", "escalate/joint.py", "models/gbdt.py",
+    "parallel/compile_plane.py",
+)
+
+
+def test_pow2_padding_lives_only_in_the_planner():
+    pkg_root = OPS_DIR.parent
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = str(path.relative_to(pkg_root)).replace("\\", "/")
+        if rel == "parallel/planner.py" or rel in _PLANNER_SHIMS:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            if "bit_length" in stripped:
+                offenders.append(f"{rel}:{lineno}: {stripped}")
+    assert not offenders, (
+        "ad-hoc pow2 pad arithmetic outside parallel/planner.py (use "
+        "planner.pow2_pad / padded_extent / plan_launches so the unified "
+        "LaunchPlan, pad-waste accounting and plan persistence cover it):\n"
+        + "\n".join(offenders))
+
+
+def test_planned_modules_reference_the_planner_seam():
+    pkg_root = OPS_DIR.parent
+    for rel in _PLANNED_MODULES:
+        text = (pkg_root / rel).read_text()
+        assert "planner" in text and (
+            "plan_launches" in text or "padded_extent" in text
+            or "pow2_pad" in text or "stored_launch_shapes" in text
+            or "plan_cv_slab_widths" in text), (
+            f"{rel} no longer routes its dispatch policy through "
+            "parallel/planner.py")
+
+
+def test_planner_shim_allowlist_is_minimal():
+    pkg_root = OPS_DIR.parent
+    for rel in _PLANNER_SHIMS:
+        assert (pkg_root / rel).is_file()
