@@ -345,7 +345,10 @@ def smoke() -> int:
     rc = dist_chaos_smoke()
     if rc:
         return rc
-    return fleet_chaos_smoke()
+    rc = fleet_chaos_smoke()
+    if rc:
+        return rc
+    return store_chaos_smoke(df)
 
 
 def _smoke_frame():
@@ -1649,6 +1652,348 @@ def fleet_chaos() -> int:
     return fleet_chaos_smoke(_smoke_frame())
 
 
+# Every artifact family the durable-store seam writes during one fully-armed
+# run, torn on its FIRST write. `store.fleet` rides the separate registration
+# scenario below and `store.fsck` is a read-side tag, so together the smoke
+# exercises every registered store site.
+STORE_CHAOS_PLAN = ",".join(
+    f"{site}:1:torn_write" for site in (
+        "store.plan", "store.checkpoint", "store.model", "store.manifest",
+        "store.snapshot_state", "store.provenance", "store.report"))
+
+
+def store_chaos_smoke(df=None) -> int:
+    """Durable state plane A/B: the same tiny repair runs with every
+    persistence plane armed (plan store, phase checkpoints, model
+    checkpoints, incremental snapshot, provenance ledger, run report) four
+    ways — clean, under STORE_CHAOS_PLAN (first write of every store site
+    torn mid-`os.replace`, the writer believing success), a recovery run
+    over the torn root (corrupt envelopes must be detected, counted,
+    quarantined, and recomputed), and a warm rerun after a quota GC sweep
+    (only planted cold junk may be evicted; surviving plans and the
+    persistent compile cache must both hit). All four frames must be
+    BIT-IDENTICAL. A fleet-registration tear and a subprocess crash
+    (`store.checkpoint:1:crash` = SIGKILL-equivalent mid-write) A/B ride
+    along. Prints one JSON line; exit code 1 on failure."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.observability import serve as obs_serve
+    from delphi_tpu.observability.fleet import FleetRouter
+    from delphi_tpu.parallel import planner, resilience
+    from delphi_tpu.parallel import store as dstore
+    from delphi_tpu.session import get_session
+
+    if df is None:
+        df = _smoke_frame()
+
+    work = tempfile.mkdtemp(prefix="delphi_store_chaos_")
+    clean_root = os.path.join(work, "clean")
+    torn_root = os.path.join(work, "torn")
+    saved = {k: os.environ.get(k)
+             for k in ("DELPHI_METRICS_PATH", "DELPHI_PROVENANCE_PATH")}
+
+    # a private persistent compile cache, populated by the clean run's cold
+    # compiles (in-memory executables dropped first: a warm caller process
+    # would otherwise never write it, starving the post-GC warm assertion)
+    saved_cc = {k: os.environ.get(k) for k in
+                ("DELPHI_COMPILE_CACHE_DIR", "DELPHI_COMPILE_CACHE_MIN_S")}
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(work, "compile")
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    jax.clear_caches()
+
+    def one_run(tag: str, root: str, plan: str, armed: bool = True) -> dict:
+        _heartbeat(f"store chaos {tag} run")
+        os.environ["DELPHI_DEVICE_TABLE"] = "1"
+        os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+        os.environ["DELPHI_METRICS_PATH"] = os.path.join(root, "report.json")
+        if armed:
+            os.environ["DELPHI_CHECKPOINT_DIR"] = os.path.join(root, "ckpt")
+            os.environ["DELPHI_PROVENANCE_PATH"] = \
+                os.path.join(root, "prov.jsonl")
+        if plan:
+            os.environ["DELPHI_FAULT_PLAN"] = plan
+        resilience.reset_fault_state()
+        # a fresh PlanStore per run: plan reads must come from the files on
+        # disk, never a previous run's in-memory mirror
+        planner.set_plan_store(os.path.join(root, "plans"))
+        # same table name on every run: checkpoint and plan fingerprints
+        # must collide so the recovery run reads the torn run's artifacts
+        name = "store_chaos"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.store.{tag}")
+        try:
+            model = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()])
+            if armed:
+                model = model \
+                    .option("model.checkpoint_path",
+                            os.path.join(root, "model")) \
+                    .option("repair.incremental", "true") \
+                    .option("repair.snapshot.dir", os.path.join(root, "snap"))
+            out = model.run()
+            # the nested run() leaves the report write to the outer
+            # recorder's owner (us): write it here, inside the recording
+            # window, so `store.report` exercises the seam under the plan
+            obs.write_run_report(
+                obs.build_run_report(rec, run={"bench": f"store.{tag}"}),
+                os.environ["DELPHI_METRICS_PATH"])
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+            planner.set_plan_store(None)
+            for k in ("DELPHI_FAULT_PLAN", "DELPHI_DEVICE_TABLE",
+                      "DELPHI_DOMAIN_DEVICE", "DELPHI_CHECKPOINT_DIR"):
+                os.environ.pop(k, None)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            resilience.reset_fault_state()
+        counters = rec.registry.snapshot()["counters"]
+        return {
+            "store": {k: int(v) for k, v in counters.items()
+                      if k.startswith("store.")},
+            "injected": int(counters.get("resilience.injected", 0)),
+            "faults": int(
+                counters.get("resilience.faults.store_corrupt", 0)),
+            "plan_hits": int(counters.get("launch.plan_cache.hits", 0)),
+            "compile_hits": int(counters.get("compile_cache.hits", 0)),
+            "frame": out.sort_values(list(out.columns))
+            .reset_index(drop=True),
+        }
+
+    def frames_equal(a, b) -> bool:
+        try:
+            pd.testing.assert_frame_equal(a, b)
+            return True
+        except AssertionError:
+            return False
+
+    base = one_run("clean", clean_root, "")
+    torn = one_run("torn", torn_root, STORE_CHAOS_PLAN)
+
+    # the torn root as an offline auditor sees it: every torn destination
+    # is a checksum-failing envelope, reported without touching anything
+    _heartbeat("store chaos fsck audit")
+    audit = dstore.fsck(torn_root, repair=False)
+
+    q0 = dstore.quarantine_count()
+    recovery = one_run("recovery", torn_root, "")
+    q1 = dstore.quarantine_count()
+
+    # -- quota GC: plant cold junk, sweep with a quota that only it breaks --
+    _heartbeat("store chaos GC sweep")
+    junk = os.path.join(torn_root, "junk.bin")
+    with open(junk, "wb") as f:
+        f.write(b"\0" * 65536)
+    stale = os.path.getmtime(junk) - 3600
+    os.utime(junk, (stale, stale))
+
+    def visible_bytes(root: str) -> int:
+        # mirror the sweep's view: quarantine dirs and .store_* files
+        # (tmp debris + the GC lock) are outside the quota
+        total = 0
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "quarantine"]
+            for n in filenames:
+                if n.startswith(".store_"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, n))
+                except OSError:
+                    pass
+        return total
+
+    def quarantined_files(root: str) -> int:
+        n = 0
+        for dirpath, dirnames, _ in os.walk(root):
+            if os.path.basename(dirpath) == "quarantine":
+                n += len(os.listdir(dirpath))
+                dirnames[:] = []
+        return n
+
+    quarantined_before = quarantined_files(torn_root)
+    quota = visible_bytes(torn_root) - 65536
+    sweep = dstore.gc_sweep(torn_root, quota=quota)
+    plan_files = [n for n in os.listdir(os.path.join(torn_root, "plans"))
+                  if n != "quarantine" and not n.startswith(".store_")]
+
+    # the GC-survived plans and persistent compile cache must both serve
+    # the warm rerun once the in-memory executables are dropped
+    jax.clear_caches()
+    warm = one_run("warm", torn_root, "", armed=False)
+    for k, v in saved_cc.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    # -- fleet registration tear: torn announcement = not-yet-registered --
+    _heartbeat("store chaos fleet registration tear")
+    router = FleetRouter(port=0, workers=2, spawn=False,
+                         cache_dir=os.path.join(work, "fleet_cache"))
+    reg_0 = os.path.join(router.fleet_dir, "worker_0.json")
+    reg_1 = os.path.join(router.fleet_dir, "worker_1.json")
+    rec = obs.start_recording("bench.store.fleet")
+    try:
+        os.environ["DELPHI_FAULT_PLAN"] = "store.fleet:1:torn_write"
+        resilience.reset_fault_state()
+        obs_serve.write_fleet_registration(
+            router.fleet_dir, reg_0, {"worker_id": 0, "port": 1})  # torn
+        obs_serve.write_fleet_registration(
+            router.fleet_dir, reg_1, {"worker_id": 1, "port": 2})  # clean
+        regs_torn = router._read_registrations()
+        os.environ.pop("DELPHI_FAULT_PLAN", None)
+        resilience.reset_fault_state()
+        # the next announcement (a worker heartbeat re-registering) heals
+        obs_serve.write_fleet_registration(
+            router.fleet_dir, reg_0, {"worker_id": 0, "port": 1})
+        regs_healed = router._read_registrations()
+    finally:
+        obs.stop_recording(rec)
+        os.environ.pop("DELPHI_FAULT_PLAN", None)
+        resilience.reset_fault_state()
+    fleet_counters = rec.registry.snapshot()["counters"]
+
+    # -- crash A/B: a hard process death mid-checkpoint-write must leave the
+    # destination untouched (only reclaimable tmp debris), and a clean rerun
+    # over the same root must land on the baseline frame
+    _heartbeat("store chaos crash A/B (subprocess)")
+    crash_dir = os.path.join(work, "crash_ckpt")
+    os.makedirs(crash_dir, exist_ok=True)
+    out_csv = os.path.join(work, "crash_out.csv")
+    child_src = (
+        "import os\n"
+        "import bench\n"
+        "from delphi_tpu import NullErrorDetector, delphi\n"
+        "from delphi_tpu.session import get_session\n"
+        "df = bench._smoke_frame()\n"
+        "get_session().register('store_chaos', df)\n"
+        "out = (delphi.repair.setTableName('store_chaos').setRowId('tid')\n"
+        "       .setErrorDetectors([NullErrorDetector()]).run())\n"
+        "out = out.sort_values(list(out.columns)).reset_index(drop=True)\n"
+        "out.to_csv(os.environ['DELPHI_STORE_CHAOS_OUT'], index=False)\n")
+
+    def crash_env(plan: str) -> dict:
+        env = dict(os.environ)
+        for k in ("DELPHI_FAULT_PLAN", "DELPHI_PLAN_DIR",
+                  "DELPHI_STORE_QUOTA_GB"):
+            env.pop(k, None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "DELPHI_CHECKPOINT_DIR": crash_dir,
+            "DELPHI_METRICS_PATH": os.path.join(work, "crash_report.json"),
+            "DELPHI_PROVENANCE_PATH": ":memory:",
+            "DELPHI_STORE_CHAOS_OUT": out_csv,
+        })
+        if plan:
+            env["DELPHI_FAULT_PLAN"] = plan
+        return env
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    crash = subprocess.run(
+        [sys.executable, "-c", child_src], cwd=repo_dir,
+        env=crash_env("store.checkpoint:1:crash"),
+        capture_output=True, text=True, timeout=600)
+    crash_wrote_csv = os.path.exists(out_csv)
+    orphans = [n for n in os.listdir(crash_dir) if n.startswith(".store_")]
+    dstore.fsck(crash_dir)  # repair pass reclaims the crash debris
+    orphans_after = [n for n in os.listdir(crash_dir)
+                     if n.startswith(".store_")]
+    clean_rerun = subprocess.run(
+        [sys.executable, "-c", child_src], cwd=repo_dir, env=crash_env(""),
+        capture_output=True, text=True, timeout=600)
+    crash_csv = None
+    if os.path.exists(out_csv):
+        with open(out_csv) as f:
+            crash_csv = f.read()
+
+    base_csv = base["frame"].to_csv(index=False)
+    checks = {
+        "clean_run_clean":
+            base["store"].get("store.writes", 0) > 0
+            and base["store"].get("store.torn_writes", 0) == 0
+            and base["store"].get("store.corrupt", 0) == 0,
+        "torn_all_sites_fired":
+            torn["store"].get("store.torn_writes", 0) == 7
+            and torn["injected"] == 7,
+        "torn_frame_bit_identical":
+            frames_equal(base["frame"], torn["frame"]),
+        "fsck_sees_torn_files":
+            audit["corrupt"] >= 4
+            and audit.get("gc", {}).get("skipped") == "report-only",
+        "recovery_quarantines":
+            recovery["store"].get("store.corrupt", 0) >= 2
+            and recovery["store"].get("store.quarantined", 0) >= 2
+            and recovery["faults"] >= 2 and q1 > q0,
+        "recovery_frame_bit_identical":
+            frames_equal(base["frame"], recovery["frame"]),
+        "gc_evicts_junk_only":
+            sweep.get("evicted_files") == 1
+            and not os.path.exists(junk)
+            and len(plan_files) > 0
+            and quarantined_files(torn_root) == quarantined_before,
+        "warm_after_gc":
+            warm["plan_hits"] > 0 and warm["compile_hits"] > 0
+            and frames_equal(base["frame"], warm["frame"]),
+        "fleet_torn_reg_skipped":
+            sorted(regs_torn) == ["1"]
+            and int(fleet_counters.get(
+                "fleet.registration_corrupt", 0)) >= 1,
+        "fleet_reg_heals": sorted(regs_healed) == ["0", "1"],
+        "crash_consistent":
+            crash.returncode == 23 and not crash_wrote_csv
+            and len(orphans) >= 1 and not orphans_after
+            and clean_rerun.returncode == 0 and crash_csv == base_csv,
+    }
+    ok = all(checks.values())
+    for r in (base, torn, recovery, warm):
+        del r["frame"]
+    print(json.dumps({
+        "metric": "store_chaos_smoke",
+        "value": torn["store"].get("store.torn_writes", 0),
+        "unit": "torn writes survived", "vs_baseline": None, "ok": ok,
+        "plan": STORE_CHAOS_PLAN, "checks": checks,
+        "clean": base, "torn": torn, "recovery": recovery, "warm": warm,
+        "fsck": {k: audit[k] for k in
+                 ("scanned", "ok", "legacy", "corrupt")},
+        "gc": sweep,
+    }), flush=True)
+    if ok:
+        shutil.rmtree(work, ignore_errors=True)
+        return 0
+    print("store chaos smoke FAILED: torn/crashed writes must never corrupt "
+          "a reader, recovery must quarantine and recompute, and GC must "
+          f"spare warm state ({checks}); work dir kept at {work}",
+          file=sys.stderr)
+    for tag, proc in (("crash", crash), ("clean_rerun", clean_rerun)):
+        if proc.returncode not in (0, 23):
+            print(f"--- {tag} child stderr tail ---\n"
+                  f"{(proc.stderr or '')[-2000:]}", file=sys.stderr)
+    return 1
+
+
+def store_chaos() -> int:
+    """Standalone `bench.py --store-chaos` entry: CPU backend, fully-armed
+    persistence planes, torn-write/crash/GC A/B (see store_chaos_smoke)."""
+    import tempfile
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_DIR",
+                          tempfile.mkdtemp(prefix="delphi_store_cc_"))
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_MIN_S", "0")
+    _force_cpu_backend()
+    return store_chaos_smoke(_smoke_frame())
+
+
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
 # On-chip measurements persist here keyed by workload@scale: the axon tunnel
@@ -1923,6 +2268,17 @@ def main() -> None:
                              "response bit-identical to a clean single-"
                              "server run and zero dropped requests; exits "
                              "1 on failure")
+    parser.add_argument("--store-chaos", dest="store_chaos",
+                        action="store_true",
+                        help="durable state plane A/B on the CPU backend: "
+                             "the smoke frame with every persistence plane "
+                             "armed, run clean, with the first write of "
+                             "every store site torn mid-replace, recovered "
+                             "over the torn root (detect + quarantine + "
+                             "recompute), and warm after a quota GC sweep, "
+                             "plus fleet-registration tear and subprocess "
+                             "crash scenarios, asserting bit-identical "
+                             "frames throughout; exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -1950,6 +2306,9 @@ def main() -> None:
 
     if args.fleet_chaos:
         sys.exit(fleet_chaos())
+
+    if args.store_chaos:
+        sys.exit(store_chaos())
 
     if args._child:
         _child_main(args)

@@ -20,10 +20,8 @@ whole-column fingerprint never looks at block boundaries).
 """
 
 import hashlib
-import json
 import os
-import pickle
-import tempfile
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -184,50 +182,115 @@ def merge_manifests(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
 
 
 # -- persistence --------------------------------------------------------------
+#
+# All snapshot I/O rides the durable-store seam (parallel/store.py):
+# envelope-framed crash-consistent writes at sites ``store.manifest`` /
+# ``store.snapshot_state``, with corrupt/truncated files quarantined as
+# misses (the caller falls back to a full run, which repopulates).
 
-def _atomic_write(path: str, data: bytes) -> None:
-    directory = os.path.dirname(path) or "."
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(prefix=".snap_", dir=directory)
+#: archived chain manifests: ``manifest.<snapshot_id>.json``
+_CHAIN_RE = re.compile(r"^manifest\.([0-9a-f]{16})\.json$")
+
+#: default chain length retained at write time (DELPHI_SNAPSHOT_CHAIN_KEEP)
+_DEFAULT_CHAIN_KEEP = 4
+
+
+def chain_keep_setting() -> int:
+    """``DELPHI_SNAPSHOT_CHAIN_KEEP``: how many superseded manifests the
+    delta chain retains after each snapshot write (default 4). The quota
+    GC sweep and fsck compact harder — down to the single live base — so
+    delta serving stays O(1) on disk regardless of run count."""
+    env = os.environ.get("DELPHI_SNAPSHOT_CHAIN_KEEP")
     try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except Exception:
+        return max(0, int(env)) if env and env.strip() else \
+            _DEFAULT_CHAIN_KEEP
+    except ValueError:
+        return _DEFAULT_CHAIN_KEEP
+
+
+def chain_files(directory: str) -> List[str]:
+    """Archived chain manifests, oldest first (by mtime, name-tiebroken)."""
+    try:
+        names = [n for n in os.listdir(directory) if _CHAIN_RE.match(n)]
+    except OSError:
+        return []
+    paths = [os.path.join(directory, n) for n in names]
+
+    def key(p: str):
         try:
-            os.unlink(tmp)
+            return (os.path.getmtime(p), p)
+        except OSError:
+            return (0.0, p)
+    return sorted(paths, key=key)
+
+
+def compact_chain(directory: str, keep: Optional[int] = None) -> int:
+    """Folds a snapshot's manifest chain down to ``keep`` archived entries
+    (default: the env setting) plus the live base ``manifest.json``.
+    Returns the number of chain files removed."""
+    keep = chain_keep_setting() if keep is None else max(0, int(keep))
+    files = chain_files(directory)
+    removed = 0
+    for path in files[:max(0, len(files) - keep)]:
+        try:
+            os.unlink(path)
+            removed += 1
         except OSError:
             pass
-        raise
+    if removed:
+        from delphi_tpu.observability import counter_inc
+        counter_inc("store.chain_compacted", removed)
+        _logger.info(f"Compacted snapshot manifest chain in {directory}: "
+                     f"removed {removed} superseded manifests "
+                     f"(keeping {keep})")
+    return removed
 
 
 def write_snapshot(directory: str, manifest: Dict[str, Any],
                    state: Dict[str, Any]) -> None:
-    """Persists a snapshot atomically: the state pickle lands before the
-    manifest, so a reader never sees a manifest pointing at a half-written
-    state (a kill between the two leaves the PREVIOUS snapshot's manifest
-    paired with the new state — detected by the fingerprint diff, which
-    falls back to a full run)."""
-    _atomic_write(os.path.join(directory, STATE_FILE), pickle.dumps(state))
-    _atomic_write(os.path.join(directory, MANIFEST_FILE),
-                  json.dumps(manifest, sort_keys=True, indent=1).encode())
+    """Persists a snapshot crash-consistently: the state pickle lands
+    before the manifest, so a reader never sees a manifest pointing at a
+    half-written state (a kill between the two leaves the PREVIOUS
+    snapshot's manifest paired with the new state — detected by the
+    fingerprint diff, which falls back to a full run). A superseded
+    manifest is archived into the delta chain
+    (``manifest.<snapshot_id>.json``) and the chain is compacted to
+    ``DELPHI_SNAPSHOT_CHAIN_KEEP`` entries."""
+    from delphi_tpu.parallel import store as dstore
+    os.makedirs(directory, exist_ok=True)
+    dstore.write_pickle(os.path.join(directory, STATE_FILE), state,
+                        schema="snapshot_state",
+                        site="store.snapshot_state", root=directory)
+    live = os.path.join(directory, MANIFEST_FILE)
+    prior = load_manifest(directory)
+    if prior is not None and prior.get("snapshot_id") \
+            and prior.get("snapshot_id") != manifest.get("snapshot_id"):
+        archived = os.path.join(
+            directory, f"manifest.{prior['snapshot_id']}.json")
+        try:
+            dstore.replace_file(live, archived)
+            manifest = dict(manifest)
+            manifest["parent_snapshot_id"] = prior["snapshot_id"]
+        except OSError as e:
+            _logger.warning(f"could not archive superseded manifest "
+                            f"{live}: {e}")
+    dstore.write_json(live, manifest, schema="snapshot_manifest",
+                      site="store.manifest", root=directory, indent=1)
+    compact_chain(directory)
     _logger.info(f"Snapshot {manifest.get('snapshot_id')} written to "
                  f"{directory} ({manifest.get('n_rows')} rows)")
 
 
 def load_manifest(directory: str) -> Optional[Dict[str, Any]]:
     """Loads a manifest, or None when missing/corrupt/unknown-version (the
-    caller falls back to a full run either way)."""
+    caller falls back to a full run either way). A corrupt file is
+    quarantined by the store seam, never silently loaded."""
+    from delphi_tpu.parallel import store as dstore
     path = os.path.join(directory, MANIFEST_FILE)
-    if not os.path.exists(path):
-        return None
-    try:
-        with open(path, "r") as f:
-            manifest = json.load(f)
-    except Exception as e:
-        _logger.warning(f"Ignoring corrupt snapshot manifest {path}: {e}")
+    manifest, status = dstore.read_json(
+        path, schema="snapshot_manifest", site="store.manifest",
+        root=directory)
+    if status in ("missing", "corrupt"):
         return None
     if not isinstance(manifest, dict) \
             or manifest.get("version") != MANIFEST_VERSION:
@@ -239,14 +302,11 @@ def load_manifest(directory: str) -> Optional[Dict[str, Any]]:
 
 def load_state(directory: str) -> Optional[Dict[str, Any]]:
     """Loads the state pickle (prior frame / models / ledger entries), or
-    None when missing or unreadable."""
-    path = os.path.join(directory, STATE_FILE)
-    if not os.path.exists(path):
-        return None
-    try:
-        with open(path, "rb") as f:
-            state = pickle.load(f)
-    except Exception as e:
-        _logger.warning(f"Ignoring corrupt snapshot state {path}: {e}")
+    None when missing or unreadable (corrupt pickles are quarantined)."""
+    from delphi_tpu.parallel import store as dstore
+    state, status = dstore.read_pickle(
+        os.path.join(directory, STATE_FILE), schema="snapshot_state",
+        site="store.snapshot_state", root=directory)
+    if status in ("missing", "corrupt"):
         return None
     return state if isinstance(state, dict) else None

@@ -27,6 +27,7 @@ docs/source/robustness.rst.
 """
 
 import argparse
+import json
 import sys
 
 import pandas as pd
@@ -73,6 +74,19 @@ def main(argv=None) -> int:
                              "--serve-port; docs/source/robustness.rst). "
                              "Equivalent to DELPHI_FLEET_WORKERS / "
                              "repair.fleet.workers")
+    parser.add_argument("--fsck", dest="fsck", type=str, default="",
+                        metavar="ROOT",
+                        help="scan a cache root through the durable-store "
+                             "seam and exit: validates every envelope "
+                             "(crc32/length/schema), reports per-store "
+                             "health as JSON, quarantines corrupt files, "
+                             "removes orphaned temp files, and runs a "
+                             "quota GC sweep when DELPHI_STORE_QUOTA_GB "
+                             "is set (docs/source/robustness.rst)")
+    parser.add_argument("--fsck-report-only", dest="fsck_report_only",
+                        action="store_true",
+                        help="with --fsck: report health without "
+                             "quarantining, deleting, or sweeping")
     parser.add_argument("--targets", dest="targets", type=str, default="",
                         help="comma-separated target attributes")
     parser.add_argument("--constraints", dest="constraints", type=str, default="",
@@ -210,6 +224,16 @@ def main(argv=None) -> int:
                              "drift divergence vs --baseline-report exceeds "
                              "this value")
     args = parser.parse_args(argv)
+
+    if args.fsck:
+        # pure-filesystem mode: no backend, no cluster join — scan the
+        # root, print per-store health, exit 0 (clean) or 4 (corruption
+        # was found, now quarantined)
+        from delphi_tpu.parallel import store as dstore
+        summary = dstore.fsck(args.fsck,
+                              repair=not args.fsck_report_only)
+        print(json.dumps(summary, indent=2, sort_keys=True))
+        return 4 if summary.get("corrupt") else 0
 
     session = get_session()
     if args.collective_timeout_s is not None:

@@ -1945,13 +1945,16 @@ class RepairModel:
         # (reference python/repair/model.py:910,921 transports models with
         # CloudPickle under the same assumption).
         ckpt = self._checkpoint_file()
-        if not ckpt or not os.path.exists(ckpt):
+        if not ckpt:
             return None
-        try:
-            with open(ckpt, "rb") as f:
-                payload = pickle.load(f)
-        except Exception as e:
-            _logger.warning(f"Ignoring unreadable model checkpoint {ckpt}: {e}")
+        from delphi_tpu.parallel import store as dstore
+        payload, status = dstore.read_pickle(
+            ckpt, schema="model_ckpt", site="store.model")
+        if status == "missing":
+            return None
+        if status == "corrupt":
+            # quarantined by the store seam — retrain, never half-load
+            _logger.warning(f"Ignoring corrupt model checkpoint {ckpt}")
             return None
         if not isinstance(payload, dict) or "models" not in payload:
             _logger.warning(
@@ -1970,10 +1973,15 @@ class RepairModel:
         ckpt = self._checkpoint_file()
         if not ckpt:
             return
+        from delphi_tpu.parallel import store as dstore
         try:
-            os.makedirs(os.path.dirname(ckpt), exist_ok=True)
-            with open(ckpt, "wb") as f:
-                pickle.dump({"fingerprint": fingerprint, "models": models}, f)
+            # durable-store seam (site ``store.model``): the pre-seam
+            # writer was a plain pickle.dump with no tmp file, no fsync
+            # and no rename — the single worst torn-write exposure in the
+            # cache root
+            dstore.write_pickle(
+                ckpt, {"fingerprint": fingerprint, "models": models},
+                schema="model_ckpt", site="store.model")
             _logger.info(f"Saved {len(models)} repair models to {ckpt}")
         except Exception as e:
             _logger.warning(f"Failed to write model checkpoint {ckpt}: {e}")

@@ -83,6 +83,8 @@ _SEED_COUNTERS = (
     "fleet.evictions", "fleet.rejoins", "fleet.dispatch_faults",
     "fleet.all_shed", "fleet.no_workers",
     "fleet.affinity.hits", "fleet.affinity.misses",
+    "fleet.registration_corrupt",
+    "store.corrupt", "store.quarantined",
 )
 
 
@@ -309,6 +311,12 @@ class FleetRouter:
     # -- membership ----------------------------------------------------------
 
     def _read_registrations(self) -> Dict[str, Dict[str, Any]]:
+        """Validated membership reads: a half-written or corrupt
+        ``worker_<id>.json`` is treated as not-yet-registered (counted
+        ``fleet.registration_corrupt``, quarantined by the store seam)
+        instead of raising mid-route — the worker's heartbeat loop
+        re-announces it on the next beat."""
+        from delphi_tpu.parallel import store as dstore
         regs: Dict[str, Dict[str, Any]] = {}
         try:
             names = os.listdir(self.fleet_dir)
@@ -317,12 +325,22 @@ class FleetRouter:
         for name in sorted(names):
             if not (name.startswith("worker_") and name.endswith(".json")):
                 continue
+            path = os.path.join(self.fleet_dir, name)
             try:
-                with open(os.path.join(self.fleet_dir, name)) as f:
-                    info = json.load(f)
-                regs[str(info["worker_id"])] = info
+                info, status = dstore.read_json(
+                    path, schema="fleet_reg", site="store.fleet",
+                    root=self.fleet_dir)
             except Exception:
-                continue  # half-written registration; next scan gets it
+                counter_inc("fleet.registration_corrupt")
+                continue
+            if status == "corrupt":
+                counter_inc("fleet.registration_corrupt")
+                continue
+            if not isinstance(info, dict) or "worker_id" not in info:
+                # legacy garbage that json-parsed but isn't a registration
+                counter_inc("fleet.registration_corrupt")
+                continue
+            regs[str(info["worker_id"])] = info
         return regs
 
     def refresh_membership(self, now: Optional[float] = None) -> List[str]:

@@ -237,6 +237,14 @@ class _Handler(BaseHTTPRequestHandler):
                     health["status"] = "degraded"
                     health["degraded_ranks"] = \
                         dist_resilience.degraded_ranks()
+                from delphi_tpu.parallel import store as dstore
+                quarantined = dstore.quarantine_count()
+                if quarantined:
+                    # corrupt artifacts were quarantined this process:
+                    # serving continues on recompute, but an operator
+                    # should look at <root>/quarantine/
+                    health["status"] = "degraded"
+                    health["quarantined"] = quarantined
                 body = json.dumps(health).encode()
                 self._respond(200, "application/json", body)
             elif path == "/metrics":

@@ -32,7 +32,6 @@ across runs.
 import contextlib
 import json
 import os
-import tempfile
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -393,32 +392,20 @@ class ProvenanceLedger:
         return rows
 
     def write(self) -> None:
-        """One-shot atomic JSONL dump (tmp + ``os.replace``); ``:memory:``
+        """One-shot crash-consistent JSONL dump through the durable-store
+        seam (site ``store.provenance``): an envelope header line (``#``
+        prefixed, so line-oriented consumers skip it) followed by one JSON
+        line per cell, then the run-level notes (resilience degradations)
+        in the same stream, distinguished by the "note" key. ``:memory:``
         skips the file entirely."""
         if self.path == MEMORY_PATH or self._written:
             return
         self._written = True
+        from delphi_tpu.parallel import store as dstore
         try:
-            directory = os.path.dirname(os.path.abspath(self.path))
-            os.makedirs(directory, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(prefix=".provenance_", dir=directory)
-            try:
-                with os.fdopen(fd, "w") as f:
-                    for e in self.entries():
-                        f.write(json.dumps(e, default=str) + "\n")
-                    # run-level notes (resilience degradations) ride in the
-                    # same JSONL stream, distinguished by the "note" key
-                    for n in self.notes():
-                        f.write(json.dumps(n, default=str) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.path)
-            except Exception:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            rows = self.entries() + self.notes()
+            dstore.write_jsonl(os.path.abspath(self.path), rows,
+                               schema="provenance", site="store.provenance")
             _logger.info(f"Provenance ledger written to {self.path} "
                          f"({len(self._cells)} cells)")
         except Exception as e:

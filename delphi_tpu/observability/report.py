@@ -62,7 +62,6 @@ those windows are credited to the phase.
 
 import json
 import os
-import tempfile
 from datetime import datetime, timezone
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -367,27 +366,15 @@ def build_run_report(recorder: Any,
 
 
 def write_run_report(report: Dict[str, Any], path: str) -> None:
-    """Atomic write: serialize to a same-directory temp file, fsync, then
-    ``os.replace`` over the destination — a run killed mid-write (or a
-    mid-write crash on a non-serializable report) never leaves a truncated
-    JSON for ``load_run_report`` to silently discard, and any pre-existing
-    report at ``path`` survives intact."""
-    directory = os.path.dirname(os.path.abspath(path))
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(prefix=".run_report_", dir=directory)
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(report, f, indent=2, sort_keys=False)
-            f.write("\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    """Crash-consistent write through the durable-store seam (site
+    ``store.report``): envelope-framed (crc32 + length), same-directory
+    temp file, fsync, ``os.replace``, directory fsync — a run killed
+    mid-write (or a mid-write crash on a non-serializable report) never
+    leaves a truncated JSON for ``load_run_report`` to silently discard,
+    and any pre-existing report at ``path`` survives intact."""
+    from delphi_tpu.parallel import store as dstore
+    dstore.write_json(os.path.abspath(path), report, schema="run_report",
+                      site="store.report", indent=2, sort_keys=False)
     _logger.info(f"Run report written to {path}")
 
 
@@ -415,12 +402,18 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
 
 def load_run_report(path: str) -> Optional[Dict[str, Any]]:
     """Loads and (when needed) upgrades a run report; ``None`` for missing
-    or unreadable files and for schema versions this build doesn't know."""
-    try:
-        with open(path) as f:
-            report = json.load(f)
-    except Exception as e:
-        _logger.warning(f"cannot load run report {path}: {e}")
+    or unreadable files and for schema versions this build doesn't know.
+    Validated through the store seam: a truncated/corrupt report is
+    quarantined and reads as missing; a pre-seam raw-JSON report (e.g. an
+    old ``--baseline-report``) loads through the legacy path."""
+    from delphi_tpu.parallel import store as dstore
+    report, status = dstore.read_json(path, schema="run_report",
+                                      site="store.report")
+    if report is None:
+        _logger.warning(f"cannot load run report {path} ({status})")
+        return None
+    if not isinstance(report, dict):
+        _logger.warning(f"cannot load run report {path}: not a JSON object")
         return None
     version = report.get("schema_version")
     if version not in SUPPORTED_SCHEMA_VERSIONS:

@@ -105,6 +105,18 @@ def table_fingerprint(table: Dict[str, Any], row_id: str) -> str:
                       sort_keys=True, default=str)
     return hashlib.sha1(blob.encode()).hexdigest()
 
+
+def write_fleet_registration(fleet_dir: str, path: str,
+                             info: Dict[str, Any]) -> None:
+    """Writes one worker registration through the durable-store seam
+    (site ``store.fleet``): envelope-framed and crash-consistent, so the
+    router can never json-parse a half-written announcement. Module-level
+    so the store-chaos bench can tear the real writer."""
+    from delphi_tpu.parallel import store as dstore
+    os.makedirs(fleet_dir, exist_ok=True)
+    dstore.write_json(path, info, schema="fleet_reg", site="store.fleet",
+                      root=fleet_dir)
+
 #: Counters pre-seeded to zero at server start so the Prometheus endpoint
 #: always exposes the full admission/resilience series (a scrape before the
 #: first fault must see `delphi_resilience_retries 0`, not a missing metric).
@@ -136,6 +148,10 @@ _SEED_COUNTERS = (
     "launch.plans", "launch.launches", "launch.buckets", "launch.pieces",
     "launch.padded_units", "launch.useful_units", "launch.merged_buckets",
     "launch.plan_cache.hits", "launch.replans",
+    "store.writes", "store.reads", "store.misses", "store.legacy",
+    "store.corrupt", "store.quarantined", "store.torn_writes",
+    "store.gc.sweeps", "store.gc.evicted_files", "store.gc.lock_busy",
+    "store.chain_compacted", "resilience.faults.store_corrupt",
 )
 
 
@@ -350,13 +366,10 @@ class RepairServer:
             return
         from delphi_tpu.parallel import dist_resilience as dr
 
-        os.makedirs(self.fleet_dir, exist_ok=True)
-        tmp = reg + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"worker_id": self.worker_id, "port": self.port,
-                       "pid": os.getpid(), "cache_dir": self.cache_dir,
-                       "started": float(time.time())}, f)
-        os.replace(tmp, reg)
+        info = {"worker_id": self.worker_id, "port": self.port,
+                "pid": os.getpid(), "cache_dir": self.cache_dir,
+                "started": float(time.time())}
+        write_fleet_registration(self.fleet_dir, reg, info)
         live = dr.member_liveness_path(self.fleet_dir, self.worker_id)
         dr.touch_liveness_file(live)
         stop = threading.Event()
@@ -365,6 +378,15 @@ class RepairServer:
         def _beat() -> None:
             while not stop.wait(interval):
                 dr.touch_liveness_file(live)
+                # a quarantined (corrupt) registration reads as
+                # not-yet-registered at the router; re-announce so the
+                # worker rejoins the ring instead of serving invisibly
+                if not os.path.exists(reg):
+                    try:
+                        write_fleet_registration(self.fleet_dir, reg, info)
+                    except OSError as e:
+                        _logger.warning(
+                            f"fleet re-registration failed: {e}")
 
         t = threading.Thread(target=_beat, daemon=True,
                              name="delphi-fleet-heartbeat")
@@ -805,13 +827,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/healthz":
+                from delphi_tpu.parallel import store as dstore
+                quarantined = dstore.quarantine_count(srv.cache_dir)
                 with srv._lock:
+                    status = "draining" if srv._draining else \
+                        ("degraded" if quarantined else "ok")
                     body = {
-                        "status": "draining" if srv._draining else "ok",
+                        "status": status,
                         "in_flight": srv._in_flight,
                         "queue_depth": srv._queue.qsize(),
                         "warm_tables": len(srv._tables),
                         "workers": srv.workers,
+                        "quarantined": quarantined,
                     }
                 self._respond(200, body)
             elif path == "/metrics":

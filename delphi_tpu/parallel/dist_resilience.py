@@ -45,7 +45,6 @@ All clocks and waits are module-level seams (``_monotonic``, ``_wall``,
 ``_wait``) so tier-1 tests drive the deadline logic against a fake clock.
 """
 
-import json
 import logging
 import os
 import threading
@@ -352,15 +351,16 @@ def _write_loss_marker(site: str, reason: str, lost: List[int],
     directory = rz.checkpoint_dir()
     if not directory:
         return
+    from delphi_tpu.parallel import store as dstore
     try:
-        os.makedirs(directory, exist_ok=True)
-        with open(os.path.join(directory, "rank_loss.json"), "w") as f:
-            json.dump({"site": site, "reason": reason,
-                       "lost_ranks": sorted(int(r) for r in lost),
-                       "diagnosis": {str(r): v
-                                     for r, v in diagnosis.items()},
-                       "surviving_rank": int(dist.process_index()),
-                       "wall_time": float(_wall())}, f)
+        dstore.write_json(
+            os.path.join(directory, "rank_loss.json"),
+            {"site": site, "reason": reason,
+             "lost_ranks": sorted(int(r) for r in lost),
+             "diagnosis": {str(r): v for r, v in diagnosis.items()},
+             "surviving_rank": int(dist.process_index()),
+             "wall_time": float(_wall())},
+            schema="marker", site="store.checkpoint", root=directory)
     except Exception as e:  # marker is best-effort evidence
         _logger.warning(f"failed to write rank_loss marker: {e}")
 

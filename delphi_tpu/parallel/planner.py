@@ -284,10 +284,13 @@ class PlanStore:
             doc = self._mem.get(fingerprint)
         if doc is not None:
             return doc
-        try:
-            with open(self._path(fingerprint), "r", encoding="utf-8") as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
+        from delphi_tpu.parallel import store as dstore
+        doc, _status = dstore.read_json(
+            self._path(fingerprint), schema="launch_plan",
+            site="store.plan", root=self.root)
+        if not isinstance(doc, dict):
+            # missing, quarantined-corrupt, or legacy garbage: a plan-cache
+            # miss either way — the phase replans and overwrites
             doc = {"version": 1, "phases": {}}
         with self._lock:
             self._mem[fingerprint] = doc
@@ -301,12 +304,15 @@ class PlanStore:
         doc = self._doc(fingerprint)
         with self._lock:
             doc.setdefault("phases", {})[phase] = payload
-            body = json.dumps(doc, sort_keys=True)
-        tmp = self._path(fingerprint) + ".tmp"
+            body = json.dumps(doc, sort_keys=True) + "\n"
+        from delphi_tpu.parallel import store as dstore
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(body)
-            os.replace(tmp, self._path(fingerprint))
+            # durable-store seam: envelope + fsync + rename + dir fsync —
+            # the pre-seam writer skipped fsync entirely, so a crash could
+            # land rename metadata with no data behind it
+            dstore.write_bytes(self._path(fingerprint), body.encode("utf-8"),
+                               schema="launch_plan", site="store.plan",
+                               root=self.root)
         except OSError:
             pass  # persistence is best-effort; planning already succeeded
         gauge_set("serve.warm_plans", self.n_plans())

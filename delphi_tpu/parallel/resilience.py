@@ -81,9 +81,7 @@ import fnmatch
 import json
 import logging
 import os
-import pickle
 import re
-import tempfile
 import threading
 import time
 import zlib
@@ -105,8 +103,9 @@ KIND_TRANSFER = "transfer"
 KIND_COMPILE = "compile"
 KIND_TRANSIENT = "transient"
 KIND_RANK_LOSS = "rank_loss"
+KIND_STORE_CORRUPT = "store_corrupt"
 FAULT_KINDS = (KIND_INIT_TIMEOUT, KIND_OOM, KIND_TRANSFER, KIND_COMPILE,
-               KIND_TRANSIENT, KIND_RANK_LOSS)
+               KIND_TRANSIENT, KIND_RANK_LOSS, KIND_STORE_CORRUPT)
 
 
 class BackendInitTimeout(RuntimeError):
@@ -120,6 +119,15 @@ class RankLost(RuntimeError):
     :func:`~delphi_tpu.parallel.dist_resilience.guarded_collective` only
     when the call site supplied no local fallback; classified as
     :data:`KIND_RANK_LOSS`."""
+
+
+class StoreCorrupt(RuntimeError):
+    """A durable-store envelope failed validation (truncated payload, crc
+    mismatch, garbled header): raised internally by parallel/store.py,
+    caught by its validated-read path, and surfaced as a quarantined
+    cache miss — never propagated to consumers. Classified as
+    :data:`KIND_STORE_CORRUPT` so ``resilience.faults.store_corrupt``
+    counts every corruption the fleet survives."""
 
 
 class FaultInjected(BaseException):
@@ -180,14 +188,28 @@ _INJECT_MESSAGES = {
     KIND_RANK_LOSS: ("DEADLINE_EXCEEDED: collective operation timed out "
                      "waiting for remote ranks (injected at {site} "
                      "call {n})"),
+    KIND_STORE_CORRUPT: ("durable store envelope failed checksum "
+                         "validation (injected at {site} call {n})"),
+    "torn_write": ("durable store write torn mid-flight "
+                   "(injected at {site} call {n})"),
     "fatal": "injected unclassifiable fault at {site} call {n}",
 }
 
 #: Plan kinds that do not raise: ``stall`` wedges the calling thread
 #: forever (a real wedge, exercised by the peers' collective watchdogs),
 #: ``rank_death`` hard-exits the process (``os._exit(17)``) — the two
-#: dist-chaos failure modes a 2-process A/B injects deterministically.
-SPECIAL_INJECT_KINDS = frozenset({"stall", "rank_death"})
+#: dist-chaos failure modes a 2-process A/B injects deterministically —
+#: and ``crash`` hard-exits with code 23 at a durable-store seam entry
+#: (tmp file written, rename not yet landed: the kill-9-mid-write tear
+#: the store-chaos A/B certifies recovery from).
+SPECIAL_INJECT_KINDS = frozenset({"stall", "rank_death", "crash"})
+
+#: Plan kinds the durable-store seam handles itself: ``torn_write`` is
+#: raised here as FaultInjected but caught inside parallel/store.py,
+#: which truncates the destination at a deterministic offset and lets
+#: the writer believe it succeeded — the tear surfaces only at the next
+#: validated read.
+STORE_INJECT_KINDS = frozenset({"torn_write"})
 
 # Case-sensitive gRPC/XLA status codes; lower-case word patterns matched
 # case-insensitively below. Order matters: the first matching kind wins, and
@@ -227,6 +249,10 @@ _WORD_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = (
     (KIND_TRANSIENT, re.compile(
         r"connection (reset|refused|closed)|socket closed|broken pipe"
         r"|temporarily unavailable|try again", re.IGNORECASE | re.DOTALL)),
+    (KIND_STORE_CORRUPT, re.compile(
+        r"store (envelope|write).{0,50}"
+        r"(checksum|crc|truncat|corrupt|torn)"
+        r"|envelope.{0,30}failed checksum", re.IGNORECASE | re.DOTALL)),
 )
 
 
@@ -240,6 +266,8 @@ def classify_fault(exc: BaseException) -> Optional[str]:
         return KIND_INIT_TIMEOUT
     if isinstance(exc, RankLost):
         return KIND_RANK_LOSS
+    if isinstance(exc, StoreCorrupt):
+        return KIND_STORE_CORRUPT
     msg = f"{type(exc).__name__}: {exc}"
     # init_timeout and rank_loss outrank the codes: both typically arrive
     # spelled DEADLINE_EXCEEDED/UNAVAILABLE, and the generic transient
@@ -337,13 +365,26 @@ KNOWN_SITES = frozenset({
     "dist.allgather_any",
     "dist.allgather_max",
     "report.gather",
+    # durable-store seam sites (parallel/store.py STORE_SITES): every
+    # artifact write passes the injection point, so torn_write/crash plan
+    # entries rehearse kill-mid-write at each store
+    "store.plan",
+    "store.checkpoint",
+    "store.model",
+    "store.manifest",
+    "store.snapshot_state",
+    "store.provenance",
+    "store.report",
+    "store.fleet",
+    "store.fsck",
 })
 
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")
 _PLAN_RANK_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*([^:\s]+)\s*:"
                            r"\s*(\d+)\s*:\s*([a-z_]+)\s*$")
 
-_PLAN_KINDS = frozenset(FAULT_KINDS) | {"fatal"} | SPECIAL_INJECT_KINDS
+_PLAN_KINDS = frozenset(FAULT_KINDS) | {"fatal"} | SPECIAL_INJECT_KINDS \
+    | STORE_INJECT_KINDS
 
 
 def parse_fault_plan(text: str):
@@ -485,6 +526,11 @@ def _fire_injection(kind: str, site: str, n: int, source: str) -> None:
         return
     if kind == "rank_death":
         os._exit(17)
+    if kind == "crash":
+        # mid-write process death at a store seam: the tmp file is on
+        # disk, the rename has not landed — restart must find the
+        # previous artifact (or a clean miss), never a half-write
+        os._exit(23)
     raise FaultInjected(kind, site, n)
 
 
@@ -741,14 +787,15 @@ def on_watchdog_stall(recorder: Any, idle_s: float) -> None:
     counter_inc("resilience.stall_aborts")
     request_abort(f"watchdog stall: no span transition for {idle_s:.1f}s")
     if directory:
+        from delphi_tpu.parallel import store as dstore
         try:
-            os.makedirs(directory, exist_ok=True)
             marker = os.path.join(directory, "stall_abort.json")
-            with open(marker, "w") as f:
-                json.dump({"idle_s": round(idle_s, 3),
-                           "active_spans": recorder.active_spans(),
-                           "transition_count": recorder.transition_count},
-                          f)
+            dstore.write_json(
+                marker,
+                {"idle_s": round(idle_s, 3),
+                 "active_spans": recorder.active_spans(),
+                 "transition_count": recorder.transition_count},
+                schema="marker", site="store.checkpoint", root=directory)
         except Exception as e:  # marker is best-effort evidence
             _logger.warning(f"failed to write stall marker: {e}")
 
@@ -945,8 +992,9 @@ class PhaseCheckpointStore:
     """Fingerprinted per-phase pickles under one directory. Same trust
     boundary as the model checkpoint (model.py): checkpoints are plain
     pickles — point the directory only at files this process (or you)
-    wrote. Writes are atomic (tmp + ``os.replace`` + fsync), so a kill
-    mid-save leaves the previous checkpoint intact."""
+    wrote. Persistence rides the durable-store seam (parallel/store.py,
+    site ``store.checkpoint``): envelope-framed, crash-consistent writes,
+    and corrupt/truncated checkpoints quarantined as cache misses."""
 
     VERSION = 1
 
@@ -962,18 +1010,19 @@ class PhaseCheckpointStore:
                             f"phase_{_PHASE_SAFE.sub('_', phase)}.pkl")
 
     def load(self, phase: str) -> Optional[Any]:
+        from delphi_tpu.parallel import store as dstore
         path = self._path(phase)
-        if not os.path.exists(path):
+        payload, status = dstore.read_pickle(
+            path, schema="phase_ckpt", site="store.checkpoint",
+            root=self.directory)
+        if status == "missing":
             counter_inc("resilience.checkpoint.misses")
             return None
-        try:
-            with open(path, "rb") as f:
-                payload = pickle.load(f)
-        except Exception as e:
-            # truncated/corrupt pickle (killed mid-write before the atomic
-            # rename landed, disk corruption, wrong file): stale, recompute
-            _logger.warning(f"Ignoring corrupt phase checkpoint "
-                            f"{path}: {e}")
+        if status == "corrupt":
+            # truncated/corrupt envelope or pickle (killed mid-write,
+            # disk corruption, wrong file): quarantined by the store
+            # seam, counted here too, recompute
+            _logger.warning(f"Ignoring corrupt phase checkpoint {path}")
             counter_inc("resilience.checkpoint.corrupt")
             return None
         if not isinstance(payload, dict) \
@@ -990,25 +1039,16 @@ class PhaseCheckpointStore:
         return payload["payload"]
 
     def save(self, phase: str, payload: Any) -> None:
+        from delphi_tpu.parallel import store as dstore
         try:
-            os.makedirs(self.directory, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(prefix=f".phase_{phase}_",
-                                       dir=self.directory)
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    pickle.dump({"version": self.VERSION,
-                                 "fingerprint": self.fingerprint,
-                                 "phase": phase,
-                                 "payload": payload}, f)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self._path(phase))
-            except Exception:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            dstore.write_pickle(
+                self._path(phase),
+                {"version": self.VERSION,
+                 "fingerprint": self.fingerprint,
+                 "phase": phase,
+                 "payload": payload},
+                schema="phase_ckpt", site="store.checkpoint",
+                root=self.directory)
             counter_inc("resilience.checkpoint.saves")
             _logger.info(
                 f"Phase '{phase}' checkpointed to {self._path(phase)}")

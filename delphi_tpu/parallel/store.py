@@ -1,0 +1,681 @@
+"""The durable-store seam: every on-disk artifact flows through here.
+
+One persistence discipline for the whole cache root (plans, snapshot
+manifests, phase/model checkpoints, provenance dumps, run reports, fleet
+registrations), replacing the per-module hand-rolled writers that PR 12's
+shared fleet root made dangerous — planner plans skipped fsync, fleet
+registrations could be read half-written, and only phase checkpoints
+detected truncation.
+
+Envelope format (one header line + raw payload bytes)::
+
+    #DELPHI-STORE v1 <schema> <length> <crc32hex>\\n
+    <payload bytes>
+
+The header carries a schema tag (what kind of artifact this claims to be),
+the payload byte length (detects truncation — the torn-write failure mode),
+and a crc32 of the payload (detects bit rot / partial overwrite). JSON
+payloads stay human-readable below the header; JSONL payloads stay
+line-parseable by skipping ``#``-prefixed lines.
+
+Write protocol: same-directory temp file -> fsync -> ``os.replace`` ->
+directory fsync. The directory fsync is the step every pre-seam writer
+skipped: without it a crash after the rename can surface an empty or
+garbage file to the next reader even though the rename "happened".
+
+Read protocol: a validated read returns ``(payload, status)`` with status
+one of ``ok`` / ``missing`` / ``legacy`` / ``corrupt``. Corruption is a
+cache miss, never a crash and never a silent load: the corrupt file is
+moved to ``<root>/quarantine/``, ``store.corrupt`` / ``store.quarantined``
+fire, and the fault is classified ``store_corrupt`` in the resilience
+taxonomy. Pre-seam files (no magic header) load through the ``legacy``
+path when the caller's deserializer accepts them, so an old cache root
+warms a new build.
+
+Chaos: every write passes the resilience injection point at its registered
+``store.*`` site, so ``DELPHI_FAULT_PLAN`` entries ``store.plan:1:crash``
+(process exit mid-write, tmp written, rename not yet landed) and
+``store.plan:1:torn_write`` (destination truncated at a deterministic
+offset, writer believes it succeeded) rehearse exactly the kill -9
+failure modes the envelope exists to catch.
+
+Quota GC: ``DELPHI_STORE_QUOTA_GB`` arms a lock-file-guarded LRU sweep
+(validated reads bump mtime, so "recently used" is meaningful) that is
+safe against concurrent fleet workers sharing one root; snapshot manifest
+chains are compacted to one base first so delta serving stays O(1) on
+disk. ``main.py --fsck <root>`` runs the same validation standalone.
+"""
+
+import json
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from delphi_tpu.observability import counter_inc, gauge_set
+
+_logger = logging.getLogger(__name__)
+
+MAGIC = b"#DELPHI-STORE"
+ENVELOPE_VERSION = 1
+
+QUARANTINE_DIR = "quarantine"
+_GC_LOCK_FILE = ".store_gc.lock"
+_TMP_PREFIX = ".store_"
+
+#: Every durable-store site, with the artifact it covers. Registered in
+#: resilience.KNOWN_SITES (test_transfer_guard.py asserts the two stay in
+#: sync) so DELPHI_FAULT_PLAN validation covers store sites, and iterated
+#: by ``bench.py --store-chaos`` — a new store consumer that forgets to
+#: register here escapes the torn-write matrix and fails the guard.
+STORE_SITES: Dict[str, str] = {
+    "store.plan": "launch-plan documents (parallel/planner.py PlanStore)",
+    "store.checkpoint": "phase checkpoints + stall/rank-loss markers",
+    "store.model": "trained-model checkpoints (model.checkpoint_path)",
+    "store.manifest": "snapshot manifest.json (incremental/manifest.py)",
+    "store.snapshot_state": "snapshot state.pkl (incremental/manifest.py)",
+    "store.provenance": "provenance ledger JSONL dumps",
+    "store.report": "run-report JSON files",
+    "store.fleet": "fleet worker registration files",
+}
+
+#: Schema tags paired with the sites above — fsck uses the tag embedded in
+#: each envelope header to report per-store health without knowing paths.
+SCHEMA_SITES: Dict[str, str] = {
+    "launch_plan": "store.plan",
+    "phase_ckpt": "store.checkpoint",
+    "marker": "store.checkpoint",
+    "model_ckpt": "store.model",
+    "snapshot_manifest": "store.manifest",
+    "snapshot_state": "store.snapshot_state",
+    "provenance": "store.provenance",
+    "run_report": "store.report",
+    "fleet_reg": "store.fleet",
+}
+
+# roots this process has touched, so health endpoints can report
+# process-wide quarantine occupancy without threading paths around
+_seen_roots: set = set()
+_seen_lock = threading.Lock()
+
+# per-root monotonic stamp of the last background GC sweep (maybe_gc
+# rate-limiting); guarded by _seen_lock
+_last_gc: Dict[str, float] = {}
+
+
+# -- envelope ---------------------------------------------------------------
+
+def encode_envelope(payload: bytes, schema: str) -> bytes:
+    """Frames payload bytes: magic, version, schema tag, length, crc32."""
+    if not isinstance(payload, bytes):
+        raise TypeError(f"payload must be bytes, got {type(payload)}")
+    header = (f"{MAGIC.decode()} v{ENVELOPE_VERSION} {schema} "
+              f"{len(payload)} {zlib.crc32(payload) & 0xFFFFFFFF:08x}\n")
+    return header.encode("ascii") + payload
+
+
+def decode_envelope(blob: bytes,
+                    schema: Optional[str] = None) -> Tuple[bytes, str]:
+    """Validates a framed blob and returns ``(payload, schema_tag)``.
+
+    Raises :class:`~delphi_tpu.parallel.resilience.StoreCorrupt` on any
+    defect: missing/garbled header, unknown version, schema mismatch,
+    length mismatch (truncation), or crc mismatch. A blob without the
+    magic prefix raises ``ValueError`` instead — that is the legacy path,
+    not corruption."""
+    from delphi_tpu.parallel.resilience import StoreCorrupt
+
+    if not blob.startswith(MAGIC):
+        raise ValueError("not a delphi-store envelope")
+    nl = blob.find(b"\n")
+    if nl < 0:
+        raise StoreCorrupt("envelope header truncated (no newline)")
+    try:
+        fields = blob[:nl].decode("ascii").split()
+    except UnicodeDecodeError as e:
+        raise StoreCorrupt(f"envelope header undecodable: {e}")
+    if len(fields) != 5:
+        raise StoreCorrupt(
+            f"envelope header malformed ({len(fields)} fields, want 5)")
+    _, version, tag, length, crc_hex = fields
+    if version != f"v{ENVELOPE_VERSION}":
+        raise StoreCorrupt(f"unknown envelope version {version!r}")
+    if schema is not None and tag != schema:
+        raise StoreCorrupt(
+            f"schema mismatch: file says {tag!r}, expected {schema!r}")
+    try:
+        want_len = int(length)
+        want_crc = int(crc_hex, 16)
+    except ValueError:
+        raise StoreCorrupt("envelope length/crc fields unparsable")
+    payload = blob[nl + 1:]
+    if len(payload) != want_len:
+        raise StoreCorrupt(
+            f"payload truncated: {len(payload)} bytes, header "
+            f"promised {want_len}")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != want_crc:
+        raise StoreCorrupt("payload crc32 mismatch")
+    return payload, tag
+
+
+# -- roots / quarantine -----------------------------------------------------
+
+def _root_for(path: str, root: Optional[str]) -> str:
+    r = os.path.abspath(root) if root else os.path.dirname(
+        os.path.abspath(path))
+    with _seen_lock:
+        _seen_roots.add(r)
+    return r
+
+
+def quarantine_dir(root: str) -> str:
+    return os.path.join(os.path.abspath(root), QUARANTINE_DIR)
+
+
+def quarantine_count(root: Optional[str] = None) -> int:
+    """Files currently sitting in quarantine under ``root`` — or, with no
+    root, under every root this process has touched (the health-endpoint
+    degrade signal)."""
+    if root is not None:
+        roots = [os.path.abspath(root)]
+    else:
+        with _seen_lock:
+            roots = sorted(_seen_roots)
+    n = 0
+    for r in roots:
+        try:
+            n += sum(1 for e in os.scandir(quarantine_dir(r))
+                     if e.is_file())
+        except OSError:
+            pass
+    return n
+
+
+def quarantine(path: str, root: str, reason: str, site: str) -> Optional[str]:
+    """Moves a corrupt artifact into ``<root>/quarantine/`` (same-volume
+    rename; falls back to unlink if even that fails) so it is never loaded
+    again but stays inspectable. Returns the quarantined path."""
+    qdir = quarantine_dir(root)
+    base = os.path.basename(path)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, base)
+        i = 1
+        while os.path.exists(dest):
+            dest = os.path.join(qdir, f"{base}.{i}")
+            i += 1
+        os.replace(path, dest)
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            return None
+        dest = None
+    counter_inc("store.quarantined")
+    _logger.warning(f"{site}: quarantined corrupt artifact {path} "
+                    f"({reason})" + (f" -> {dest}" if dest else " (removed)"))
+    return dest
+
+
+def _note_corrupt(path: str, site: str, root: str, exc: BaseException) -> None:
+    from delphi_tpu.parallel import resilience as rz
+    counter_inc("store.corrupt")
+    rz.note_fault(exc, site)
+    quarantine(path, root, str(exc), site)
+
+
+# -- atomic writes ----------------------------------------------------------
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds; rename alone must do
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _torn_offset(site: str, length: int) -> int:
+    # deterministic tear point so a chaos replay tears identically
+    return zlib.crc32(site.encode()) % max(1, length)
+
+
+def _inject_mid_write(site: str, blob: bytes, path: str) -> bool:
+    """The store seam's chaos point, entered after the tmp file is fully
+    written and fsynced, before the rename. ``crash`` plan entries exit
+    the process here (handled inside resilience._fire_injection);
+    ``torn_write`` entries are caught HERE: the destination gets a
+    truncated copy of the envelope and the writer proceeds as if the
+    write succeeded — the tear only surfaces at the next validated read.
+    Any other injected kind propagates to the caller's error handling.
+    Returns True when the write was torn (caller must skip the rename)."""
+    from delphi_tpu.parallel import resilience as rz
+    try:
+        rz._maybe_inject(site)
+    except rz.FaultInjected as e:
+        if getattr(e, "kind", None) != "torn_write":
+            raise
+        cut = _torn_offset(site, len(blob))
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+            f.flush()
+            os.fsync(f.fileno())
+        counter_inc("store.torn_writes")
+        _logger.warning(f"{site}: injected torn write — {path} truncated "
+                        f"at byte {cut} of {len(blob)}")
+        return True
+    return False
+
+
+def write_bytes(path: str, payload: bytes, *, schema: str, site: str,
+                root: Optional[str] = None) -> None:
+    """Writes one envelope-framed artifact crash-consistently. Raises
+    ``OSError`` upward — callers that treat persistence as best-effort
+    keep their own try/except, exactly as before the seam."""
+    r = _root_for(path, root)
+    blob = encode_envelope(payload, schema)
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=_TMP_PREFIX, dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        torn = _inject_mid_write(site, blob, path)
+        if torn:
+            os.unlink(tmp)
+        else:
+            os.replace(tmp, path)
+            _fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    counter_inc("store.writes")
+    maybe_gc(r)
+
+
+def read_bytes(path: str, *, schema: str, site: str,
+               root: Optional[str] = None) -> Tuple[Optional[bytes], str]:
+    """Validated read: ``(payload, "ok")``, ``(None, "missing")``,
+    ``(raw_blob, "legacy")`` for pre-seam files (caller decides whether
+    its deserializer accepts them), or ``(None, "corrupt")`` after the
+    file has been quarantined and counted."""
+    r = _root_for(path, root)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        counter_inc("store.misses")
+        return None, "missing"
+    except OSError as e:
+        _logger.warning(f"{site}: unreadable {path}: {e}")
+        counter_inc("store.misses")
+        return None, "missing"
+    try:
+        payload, _ = decode_envelope(blob, schema)
+    except ValueError:
+        counter_inc("store.legacy")
+        return blob, "legacy"
+    except BaseException as e:
+        from delphi_tpu.parallel.resilience import StoreCorrupt
+        if not isinstance(e, StoreCorrupt):
+            raise
+        _note_corrupt(path, site, r, e)
+        return None, "corrupt"
+    counter_inc("store.reads")
+    try:
+        os.utime(path)  # LRU recency stamp for the quota sweep
+    except OSError:
+        pass
+    return payload, "ok"
+
+
+def mark_corrupt(path: str, site: str, reason: str,
+                 root: Optional[str] = None) -> None:
+    """Quarantines a file whose ENVELOPE validated but whose payload the
+    consumer could not deserialize (writer bug / legacy garbage): same
+    counters and taxonomy as an envelope failure."""
+    from delphi_tpu.parallel.resilience import StoreCorrupt
+    _note_corrupt(path, site, _root_for(path, root), StoreCorrupt(reason))
+
+
+def write_json(path: str, obj: Any, *, schema: str, site: str,
+               root: Optional[str] = None, indent: Optional[int] = None,
+               sort_keys: bool = True) -> None:
+    # no default= fallback: a non-serializable payload must raise BEFORE
+    # any file operation so an existing artifact survives intact
+    body = json.dumps(obj, sort_keys=sort_keys, indent=indent) + "\n"
+    write_bytes(path, body.encode("utf-8"), schema=schema, site=site,
+                root=root)
+
+
+def read_json(path: str, *, schema: str, site: str,
+              root: Optional[str] = None) -> Tuple[Optional[Any], str]:
+    payload, status = read_bytes(path, schema=schema, site=site, root=root)
+    if payload is None:
+        return None, status
+    try:
+        return json.loads(payload.decode("utf-8")), status
+    except (ValueError, UnicodeDecodeError) as e:
+        mark_corrupt(path, site, f"json payload unparsable: {e}", root)
+        return None, "corrupt"
+
+
+def write_pickle(path: str, obj: Any, *, schema: str, site: str,
+                 root: Optional[str] = None) -> None:
+    write_bytes(path, pickle.dumps(obj), schema=schema, site=site, root=root)
+
+
+def read_pickle(path: str, *, schema: str, site: str,
+                root: Optional[str] = None) -> Tuple[Optional[Any], str]:
+    """Same trust boundary as the model/phase checkpoints: pickles execute
+    code on load — point stores only at directories this process wrote."""
+    payload, status = read_bytes(path, schema=schema, site=site, root=root)
+    if payload is None:
+        return None, status
+    try:
+        return pickle.loads(payload), status
+    except Exception as e:
+        mark_corrupt(path, site, f"pickle payload unparsable: {e}", root)
+        return None, "corrupt"
+
+
+def write_jsonl(path: str, rows: Iterable[Any], *, schema: str, site: str,
+                root: Optional[str] = None) -> None:
+    body = "".join(json.dumps(r, default=str) + "\n" for r in rows)
+    write_bytes(path, body.encode("utf-8"), schema=schema, site=site,
+                root=root)
+
+
+def read_jsonl(path: str, *, schema: str, site: str,
+               root: Optional[str] = None) -> Tuple[Optional[List[Any]], str]:
+    payload, status = read_bytes(path, schema=schema, site=site, root=root)
+    if payload is None:
+        return None, status
+    try:
+        lines = payload.decode("utf-8").splitlines()
+        return [json.loads(ln) for ln in lines
+                if ln.strip() and not ln.startswith("#")], status
+    except (ValueError, UnicodeDecodeError) as e:
+        mark_corrupt(path, site, f"jsonl payload unparsable: {e}", root)
+        return None, "corrupt"
+
+
+def replace_file(src: str, dst: str) -> None:
+    """Durable same-volume rename (``os.replace`` + directory fsync) for
+    artifact moves that stay inside the store discipline — e.g. archiving
+    a snapshot manifest into its chain."""
+    os.replace(src, dst)
+    _fsync_dir(os.path.dirname(os.path.abspath(dst)) or ".")
+
+
+# -- quota GC ---------------------------------------------------------------
+
+def quota_bytes() -> Optional[int]:
+    """``DELPHI_STORE_QUOTA_GB`` as bytes, or None when unset/unparsable
+    (GC disarmed — today's unbounded behavior)."""
+    raw = os.environ.get("DELPHI_STORE_QUOTA_GB")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        gb = float(raw.strip())
+    except ValueError:
+        _logger.warning(f"DELPHI_STORE_QUOTA_GB: unparsable {raw!r}")
+        return None
+    return max(0, int(gb * (1 << 30)))
+
+
+def _gc_interval_s() -> float:
+    raw = os.environ.get("DELPHI_STORE_GC_INTERVAL_S")
+    try:
+        return max(0.0, float(raw)) if raw and raw.strip() else 60.0
+    except ValueError:
+        return 60.0
+
+
+def _gc_lock_stale_s() -> float:
+    raw = os.environ.get("DELPHI_STORE_GC_LOCK_STALE_S")
+    try:
+        return max(1.0, float(raw)) if raw and raw.strip() else 600.0
+    except ValueError:
+        return 600.0
+
+
+def _acquire_gc_lock(root: str, now: Optional[float] = None) -> Optional[str]:
+    """O_CREAT|O_EXCL lock file: the cross-process mutual exclusion that
+    keeps N fleet workers from sweeping one root concurrently. A lock
+    older than DELPHI_STORE_GC_LOCK_STALE_S (default 600 s) is presumed
+    abandoned by a killed sweeper and broken."""
+    lock = os.path.join(root, _GC_LOCK_FILE)
+    for attempt in (0, 1):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{os.getpid()} {time.time()}\n")
+            return lock
+        except FileExistsError:
+            try:
+                age = (now if now is not None else time.time()) \
+                    - os.path.getmtime(lock)
+            except OSError:
+                continue  # holder finished between open and stat; retry
+            if attempt == 0 and age > _gc_lock_stale_s():
+                _logger.warning(f"breaking stale GC lock {lock} "
+                                f"({age:.0f}s old)")
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+                continue
+            counter_inc("store.gc.lock_busy")
+            return None
+        except OSError:
+            return None
+    return None
+
+
+def _is_tmp_debris(name: str) -> bool:
+    return name.startswith((_TMP_PREFIX, ".snap_", ".run_report_",
+                            ".provenance_", ".phase_")) \
+        or name.endswith(".tmp")
+
+
+def gc_sweep(root: str, quota: Optional[int] = None,
+             protect: Iterable[str] = (),
+             now: Optional[float] = None) -> Dict[str, Any]:
+    """One quota sweep of a cache root. Under the lock: removes orphaned
+    temp files (crash debris), compacts snapshot manifest chains to one
+    base, then evicts least-recently-used files (validated reads bump
+    mtime) until the root fits ``quota`` (default: the env quota). Paths
+    under a ``protect`` prefix — the active fingerprint's warm state —
+    are never evicted. Returns a summary dict; ``{"skipped": ...}`` when
+    another process holds the lock or no quota applies."""
+    root = os.path.abspath(root)
+    quota = quota_bytes() if quota is None else quota
+    if quota is None:
+        return {"skipped": "no quota"}
+    lock = _acquire_gc_lock(root, now=now)
+    if lock is None:
+        return {"skipped": "locked"}
+    try:
+        counter_inc("store.gc.sweeps")
+        tick = now if now is not None else time.time()
+        protect_abs = tuple(os.path.abspath(p) for p in protect)
+        removed_tmp = 0
+        compacted = 0
+        entries: List[Tuple[float, int, str]] = []  # (mtime, size, path)
+        from delphi_tpu.incremental import manifest as mf
+        for dirpath, dirnames, filenames in os.walk(root):
+            # quarantined evidence is exempt from the quota: operators
+            # clear it by hand once inspected. Nested roots (per-artifact
+            # directories under a shared cache root) keep their own
+            # quarantine dirs, so prune by name, not just at the top.
+            dirnames[:] = [d for d in dirnames if d != QUARANTINE_DIR]
+            if mf.MANIFEST_FILE in filenames:
+                compacted += mf.compact_chain(dirpath, keep=0)
+                filenames = [n for n in os.listdir(dirpath)
+                             if os.path.isfile(os.path.join(dirpath, n))]
+            for name in filenames:
+                path = os.path.join(dirpath, name)
+                if name == _GC_LOCK_FILE:
+                    continue
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                if _is_tmp_debris(name):
+                    # only debris OLD enough that no live writer owns it
+                    if tick - st.st_mtime > 60.0:
+                        try:
+                            os.unlink(path)
+                            removed_tmp += 1
+                        except OSError:
+                            pass
+                    continue
+                entries.append((st.st_mtime, int(st.st_size), path))
+        total = sum(size for _, size, _ in entries)
+        evicted_files = 0
+        evicted_bytes = 0
+        entries.sort()  # oldest mtime first: LRU order
+        for mtime, size, path in entries:
+            if total <= quota:
+                break
+            if any(os.path.abspath(path).startswith(p)
+                   for p in protect_abs):
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            evicted_files += 1
+            evicted_bytes += size
+        counter_inc("store.gc.evicted_files", evicted_files)
+        gauge_set("store.root_bytes", float(total))
+        if evicted_files or removed_tmp or compacted:
+            _logger.info(
+                f"store GC swept {root}: evicted {evicted_files} files "
+                f"({evicted_bytes} bytes), {removed_tmp} tmp orphans, "
+                f"{compacted} chain manifests; {total} bytes remain "
+                f"(quota {quota})")
+        return {"root": root, "quota": quota, "total_bytes": total,
+                "evicted_files": evicted_files,
+                "evicted_bytes": evicted_bytes,
+                "tmp_removed": removed_tmp, "chain_compacted": compacted}
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+def maybe_gc(root: str) -> None:
+    """Opportunistic sweep after a write: fires at most once per
+    DELPHI_STORE_GC_INTERVAL_S (default 60 s) per root, only when a quota
+    is armed. Never raises — GC must not fail the write that triggered
+    it."""
+    if quota_bytes() is None:
+        return
+    root = os.path.abspath(root)
+    tick = time.monotonic()
+    with _seen_lock:
+        last = _last_gc.get(root)
+        if last is not None and tick - last < _gc_interval_s():
+            return
+        _last_gc[root] = tick
+    try:
+        gc_sweep(root)
+    except Exception as e:  # pragma: no cover - defensive
+        _logger.warning(f"store GC sweep of {root} failed: {e}")
+
+
+def reset_gc_state() -> None:
+    """Forgets per-root sweep stamps and seen roots (tests / benches)."""
+    with _seen_lock:
+        _last_gc.clear()
+        _seen_roots.clear()
+
+
+# -- fsck -------------------------------------------------------------------
+
+def fsck(root: str, repair: bool = True) -> Dict[str, Any]:
+    """Scans a cache root: validates every envelope, reports per-store
+    health keyed by the schema tags found, and (with ``repair``)
+    quarantines corrupt entries and removes orphaned temp files. Legacy
+    (pre-seam) files are reported but left alone — their consumers still
+    read them through the legacy path."""
+    from delphi_tpu.parallel.resilience import StoreCorrupt
+
+    root = os.path.abspath(root)
+    _root_for(os.path.join(root, "x"), root)
+    per_store: Dict[str, Dict[str, int]] = {}
+    summary = {"root": root, "scanned": 0, "ok": 0, "legacy": 0,
+               "corrupt": 0, "quarantined": 0, "tmp_removed": 0}
+
+    def bucket(tag: str) -> Dict[str, int]:
+        return per_store.setdefault(
+            tag, {"ok": 0, "legacy": 0, "corrupt": 0})
+
+    for dirpath, dirnames, filenames in os.walk(root):
+        # prune quarantine dirs by name so nested per-artifact roots under
+        # a shared cache root don't get their evidence re-flagged as corrupt
+        dirnames[:] = [d for d in dirnames if d != QUARANTINE_DIR]
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            if name == _GC_LOCK_FILE:
+                continue
+            if _is_tmp_debris(name):
+                if repair:
+                    try:
+                        os.unlink(path)
+                        summary["tmp_removed"] += 1
+                    except OSError:
+                        pass
+                continue
+            summary["scanned"] += 1
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue
+            if not blob.startswith(MAGIC):
+                summary["legacy"] += 1
+                bucket("(legacy)")["legacy"] += 1
+                continue
+            try:
+                _, tag = decode_envelope(blob)
+            except StoreCorrupt as e:
+                summary["corrupt"] += 1
+                site = "store.fsck"
+                nl = blob.find(b"\n")
+                head = blob[:nl if 0 <= nl < 200 else 200]
+                fields = head.decode("ascii", "replace").split()
+                tag = fields[2] if len(fields) >= 3 else "(unreadable)"
+                bucket(tag)["corrupt"] += 1
+                if repair:
+                    counter_inc("store.corrupt")
+                    from delphi_tpu.parallel import resilience as rz
+                    rz.note_fault(
+                        StoreCorrupt(f"fsck: {path}: {e}"),
+                        SCHEMA_SITES.get(tag, site))
+                    if quarantine(path, root, str(e),
+                                  SCHEMA_SITES.get(tag, site)):
+                        summary["quarantined"] += 1
+                continue
+            summary["ok"] += 1
+            bucket(tag)["ok"] += 1
+    summary["per_store"] = per_store
+    summary["quarantine_files"] = quarantine_count(root)
+    gc = gc_sweep(root) if repair else {"skipped": "report-only"}
+    summary["gc"] = gc
+    return summary

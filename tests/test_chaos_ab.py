@@ -23,6 +23,16 @@
   completes bit-identical to a clean single-server run (zero drops),
   with ``fleet.evictions``/``fleet.redispatches`` fired and ``/healthz``
   reporting ``degraded``.
+- ``bench.store_chaos_smoke``: the durable state plane A/B — every
+  persistence plane armed (plan store, phase/model checkpoints,
+  incremental snapshot, provenance ledger, run report); the first write
+  of every store site is torn mid-``os.replace`` with the writer
+  believing success, a recovery run must detect + quarantine + recompute,
+  a quota GC sweep may evict only planted cold junk before a warm rerun
+  hits surviving plans and the compile cache, a torn fleet registration
+  reads as not-yet-registered, and a subprocess crash mid-checkpoint
+  leaves only reclaimable tmp debris — with every completed frame
+  bit-identical to the clean run.
 
 All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
@@ -35,6 +45,7 @@ import pytest
 import bench
 from delphi_tpu.parallel import dist_resilience as dr
 from delphi_tpu.parallel import resilience as rz
+from delphi_tpu.parallel import store as dstore
 
 
 @pytest.fixture(autouse=True)
@@ -47,11 +58,15 @@ def _clean_chaos_state():
               "DELPHI_LIVENESS_DIR", "DELPHI_CHECKPOINT_DIR",
               "DELPHI_FLEET_DIR", "DELPHI_FLEET_WORKER_ID",
               "DELPHI_FLEET_HEARTBEAT_S", "DELPHI_FLEET_WORKERS",
-              "DELPHI_FLEET_MAX_HOPS", "DELPHI_FLEET_SPAWN_TIMEOUT_S")}
+              "DELPHI_FLEET_MAX_HOPS", "DELPHI_FLEET_SPAWN_TIMEOUT_S",
+              "DELPHI_METRICS_PATH", "DELPHI_PROVENANCE_PATH",
+              "DELPHI_STORE_QUOTA_GB", "DELPHI_STORE_GC_INTERVAL_S",
+              "DELPHI_STORE_GC_LOCK_STALE_S", "DELPHI_SNAPSHOT_CHAIN_KEEP")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
     dr.reset_dist_state()
+    dstore.reset_gc_state()
     yield
     for v, old in saved.items():
         if old is None:
@@ -62,6 +77,7 @@ def _clean_chaos_state():
     rz.clear_abort()
     rz.clear_cpu_fallback()
     dr.reset_dist_state()
+    dstore.reset_gc_state()
 
 
 def test_chaos_smoke_ab_bit_identical():
@@ -78,3 +94,7 @@ def test_dist_chaos_survivor_bit_identical():
 
 def test_fleet_chaos_failover_bit_identical():
     assert bench.fleet_chaos_smoke() == 0
+
+
+def test_store_chaos_durability_bit_identical():
+    assert bench.store_chaos_smoke(bench._smoke_frame()) == 0

@@ -66,7 +66,11 @@ def test_timeout_declares_rank_loss_and_degrades(monkeypatch, tmp_path):
     assert counters["resilience.dist.single_host_latch"] == 1
     assert counters["resilience.faults.rank_loss"] == 1
 
-    marker = json.loads((tmp_path / "rank_loss.json").read_text())
+    from delphi_tpu.parallel import store as dstore
+    marker, mstatus = dstore.read_json(
+        str(tmp_path / "rank_loss.json"), schema="marker",
+        site="store.checkpoint", root=str(tmp_path))
+    assert mstatus == "ok"
     assert marker["site"] == "dist.allgather_sum"
     assert marker["lost_ranks"] == [1]
     assert marker["surviving_rank"] == 0

@@ -170,8 +170,13 @@ def test_persisted_plan_reloads_and_invalidates(tmp_path):
         warm = planner.plan_launches("t.store", PIECES, fingerprint=fp)
         assert warm.cached
         assert warm.launches == cold.launches
-        # stored as pure data on disk
-        doc = json.loads((tmp_path / f"{fp}.json").read_text())
+        # stored as pure data on disk, framed by the durable-store
+        # envelope (header line + JSON payload)
+        from delphi_tpu.parallel import store as dstore
+        doc, status = dstore.read_json(
+            str(tmp_path / f"{fp}.json"), schema="launch_plan",
+            site="store.plan", root=str(tmp_path))
+        assert status == "ok"
         assert doc["phases"]["t.store"]["signature"] == cold.signature
 
         # piece-set change invalidates: replan, store updated

@@ -67,7 +67,9 @@ def test_e2e_ledger_covers_every_update(tiny, tmp_path, monkeypatch):
     assert provenance.active_ledger() is None  # detached at stop_recording
 
     entries = {(e["row_id"], e["attribute"]): e
-               for e in map(json.loads, ledger_path.read_text().splitlines())}
+               for e in map(json.loads,
+                            (ln for ln in ledger_path.read_text().splitlines()
+                             if ln and not ln.startswith("#")))}
     assert entries
     # acceptance bar: every output updates row has a matching ledger entry
     # with detector, domain size, top-k posterior, and decision reason

@@ -217,8 +217,11 @@ def test_on_watchdog_stall_writes_marker_and_arms_abort(tmp_path):
     assert rz.abort_requested() is not None
     marker = tmp_path / "stall_abort.json"
     assert marker.is_file()
-    import json
-    data = json.loads(marker.read_text())
+    from delphi_tpu.parallel import store as dstore
+    data, status = dstore.read_json(
+        str(marker), schema="marker", site="store.checkpoint",
+        root=str(tmp_path))
+    assert status == "ok"
     assert data["idle_s"] == 123.4 and data["transition_count"] == 7
 
 

@@ -60,7 +60,8 @@ def test_run_report_end_to_end(tiny, tmp_path, monkeypatch):
     assert len(repaired) == 5
     assert obs.current_recorder() is None, "recorder must deactivate"
 
-    report = json.loads(report_path.read_text())
+    report = obs.load_run_report(str(report_path))
+    assert report is not None
 
     # schema basics
     assert report["schema_version"] == obs.REPORT_SCHEMA_VERSION
@@ -109,7 +110,8 @@ def test_run_report_written_on_failure(session, tmp_path, monkeypatch):
     monkeypatch.setenv("DELPHI_METRICS_PATH", str(report_path))
     with pytest.raises(ValueError):
         delphi.repair.setTableName("no_such_table").setRowId("tid").run()
-    report = json.loads(report_path.read_text())
+    report = obs.load_run_report(str(report_path))
+    assert report is not None
     assert report["status"] == "error"
     assert "error" in report
     assert obs.current_recorder() is None

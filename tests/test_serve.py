@@ -283,8 +283,10 @@ def test_fleet_registration_and_liveness_lifecycle(tmp_path):
     reg_path = os.path.join(fleet_dir, "worker_7.json")
     live_path = dr.member_liveness_path(fleet_dir, "7")
     try:
-        with open(reg_path) as f:
-            reg = json.load(f)
+        from delphi_tpu.parallel import store as dstore
+        reg, status = dstore.read_json(reg_path, schema="fleet_reg",
+                                       site="store.fleet", root=fleet_dir)
+        assert status == "ok"
         assert reg["worker_id"] == "7"
         assert reg["port"] == srv.port
         assert reg["pid"] == os.getpid()

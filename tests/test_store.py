@@ -1,0 +1,459 @@
+"""The durable-store seam: envelope validation, torn-write/crash
+recovery, quarantine, quota GC, and fsck (ISSUE 13).
+
+The crash matrix here is deliberately exhaustive about WHERE a tear
+lands (inside the magic, inside the header, at the header/payload
+boundary, mid-payload, one byte short) because each offset exercises a
+different branch of decode_envelope — and the pre-seam writers would
+have silently loaded several of them.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from delphi_tpu import observability as obs
+from delphi_tpu.parallel import resilience as rz
+from delphi_tpu.parallel import store as dstore
+
+
+@pytest.fixture(autouse=True)
+def _clean_store_state():
+    for var in ("DELPHI_FAULT_PLAN", "DELPHI_STORE_QUOTA_GB",
+                "DELPHI_STORE_GC_INTERVAL_S", "DELPHI_STORE_GC_LOCK_STALE_S",
+                "DELPHI_SNAPSHOT_CHAIN_KEEP"):
+        os.environ.pop(var, None)
+    rz.reset_fault_state()
+    dstore.reset_gc_state()
+    yield
+    for var in ("DELPHI_FAULT_PLAN", "DELPHI_STORE_QUOTA_GB",
+                "DELPHI_STORE_GC_INTERVAL_S", "DELPHI_STORE_GC_LOCK_STALE_S",
+                "DELPHI_SNAPSHOT_CHAIN_KEEP"):
+        os.environ.pop(var, None)
+    rz.reset_fault_state()
+    dstore.reset_gc_state()
+
+
+# -- envelope round-trips -----------------------------------------------------
+
+def test_envelope_roundtrip_bytes():
+    payload = b"\x00\x01binary\xffpayload"
+    blob = dstore.encode_envelope(payload, "model_ckpt")
+    assert blob.startswith(dstore.MAGIC)
+    out, tag = dstore.decode_envelope(blob, "model_ckpt")
+    assert out == payload and tag == "model_ckpt"
+
+
+def test_envelope_schema_mismatch_is_corrupt():
+    blob = dstore.encode_envelope(b"x", "launch_plan")
+    with pytest.raises(rz.StoreCorrupt):
+        dstore.decode_envelope(blob, "model_ckpt")
+
+
+def test_envelope_without_magic_is_legacy_not_corrupt():
+    with pytest.raises(ValueError):
+        dstore.decode_envelope(b'{"plain": "json"}')
+
+
+def test_json_jsonl_pickle_roundtrips(tmp_path):
+    root = str(tmp_path)
+    jp = os.path.join(root, "a.json")
+    dstore.write_json(jp, {"k": [1, 2]}, schema="run_report",
+                      site="store.report", root=root)
+    obj, status = dstore.read_json(jp, schema="run_report",
+                                   site="store.report", root=root)
+    assert (obj, status) == ({"k": [1, 2]}, "ok")
+    # json payload stays human-readable below the header line
+    lines = open(jp).read().splitlines()
+    assert lines[0].startswith("#DELPHI-STORE v1 run_report ")
+    assert json.loads(lines[1]) == {"k": [1, 2]}
+
+    lp = os.path.join(root, "a.jsonl")
+    rows = [{"n": 1}, {"n": 2}]
+    dstore.write_jsonl(lp, rows, schema="provenance",
+                       site="store.provenance", root=root)
+    out, status = dstore.read_jsonl(lp, schema="provenance",
+                                    site="store.provenance", root=root)
+    assert (out, status) == (rows, "ok")
+
+    pp = os.path.join(root, "a.pkl")
+    dstore.write_pickle(pp, {"arr": (1, 2)}, schema="phase_ckpt",
+                        site="store.checkpoint", root=root)
+    obj, status = dstore.read_pickle(pp, schema="phase_ckpt",
+                                     site="store.checkpoint", root=root)
+    assert (obj, status) == ({"arr": (1, 2)}, "ok")
+
+
+def test_legacy_raw_json_reads_through(tmp_path):
+    path = str(tmp_path / "old.json")
+    with open(path, "w") as f:
+        json.dump({"pre": "seam"}, f)
+    obj, status = dstore.read_json(path, schema="run_report",
+                                   site="store.report", root=str(tmp_path))
+    assert status == "legacy" and obj == {"pre": "seam"}
+    assert os.path.exists(path)  # legacy files are never quarantined
+
+
+# -- the tear matrix ----------------------------------------------------------
+
+def _tear_offsets(blob: bytes):
+    header_end = blob.index(b"\n") + 1
+    return sorted({0, 1, len(dstore.MAGIC) - 1, header_end - 1,
+                   header_end, header_end + 1, len(blob) // 2,
+                   len(blob) - 1})
+
+
+def test_truncation_at_every_boundary_reads_as_miss(tmp_path):
+    """A file torn at ANY byte offset must read as corrupt/quarantined
+    (or unparsable-legacy, below the magic) — never load half a plan."""
+    root = str(tmp_path)
+    payload = {"phases": {"freq": {"chunks": [4, 4]}}}
+    for i, cut in enumerate(_tear_offsets(
+            dstore.encode_envelope(
+                (json.dumps(payload) + "\n").encode(), "launch_plan"))):
+        path = os.path.join(root, f"plan_{i}.json")
+        dstore.write_json(path, payload, schema="launch_plan",
+                          site="store.plan", root=root)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        obj, status = dstore.read_json(path, schema="launch_plan",
+                                       site="store.plan", root=root)
+        assert obj is None, f"cut={cut} loaded garbage"
+        assert status == "corrupt", f"cut={cut}: {status}"
+        assert not os.path.exists(path), f"cut={cut} left corrupt file"
+    assert dstore.quarantine_count(root) == i + 1
+
+
+def test_bit_flip_in_payload_is_quarantined(tmp_path):
+    root = str(tmp_path)
+    path = os.path.join(root, "r.json")
+    dstore.write_json(path, {"v": 1}, schema="run_report",
+                      site="store.report", root=root)
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0x40  # flip one bit inside the payload
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    rec = obs.start_recording("store.bitflip")
+    try:
+        obj, status = dstore.read_json(path, schema="run_report",
+                                       site="store.report", root=root)
+    finally:
+        obs.stop_recording(rec)
+    assert (obj, status) == (None, "corrupt")
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["store.corrupt"] == 1
+    assert counters["store.quarantined"] == 1
+    assert counters["resilience.faults.store_corrupt"] == 1
+    qdir = dstore.quarantine_dir(root)
+    assert os.listdir(qdir) == ["r.json"]
+
+
+# -- injected torn writes and crashes ----------------------------------------
+
+def test_injected_torn_write_surfaces_at_next_read(tmp_path):
+    """store.plan:1:torn_write — the writer believes it succeeded; the
+    next validated read quarantines and reports a miss; a rewrite
+    recovers."""
+    root = str(tmp_path)
+    path = os.path.join(root, "plan.json")
+    os.environ["DELPHI_FAULT_PLAN"] = "store.plan:1:torn_write"
+    rz.reset_fault_state()
+    rec = obs.start_recording("store.torn")
+    try:
+        dstore.write_json(path, {"v": 1}, schema="launch_plan",
+                          site="store.plan", root=root)  # no exception
+        assert os.path.exists(path)
+        obj, status = dstore.read_json(path, schema="launch_plan",
+                                       site="store.plan", root=root)
+        assert (obj, status) == (None, "corrupt")
+        # second write is past the :1: trigger — recovery is clean
+        dstore.write_json(path, {"v": 2}, schema="launch_plan",
+                          site="store.plan", root=root)
+        obj, status = dstore.read_json(path, schema="launch_plan",
+                                       site="store.plan", root=root)
+        assert (obj, status) == ({"v": 2}, "ok")
+    finally:
+        obs.stop_recording(rec)
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["store.torn_writes"] == 1
+    assert counters["store.corrupt"] == 1
+    # no tmp debris left behind by the torn write
+    debris = [n for n in os.listdir(root) if n.startswith(".store_")]
+    assert debris == []
+
+
+def test_injected_crash_kills_process_before_rename(tmp_path):
+    """store.plan:1:crash hard-exits with code 23 after the tmp fsync,
+    before the rename: the destination must hold the PREVIOUS
+    generation, and fsck must reclaim the tmp orphan."""
+    root = str(tmp_path)
+    path = os.path.join(root, "plan.json")
+    dstore.write_json(path, {"gen": 1}, schema="launch_plan",
+                      site="store.plan", root=root)
+    script = (
+        "import os\n"
+        "os.environ['DELPHI_FAULT_PLAN'] = 'store.plan:1:crash'\n"
+        "from delphi_tpu.parallel import store as dstore\n"
+        f"dstore.write_json({path!r}, {{'gen': 2}}, schema='launch_plan',\n"
+        f"                  site='store.plan', root={root!r})\n"
+        "raise SystemExit(99)  # unreachable: crash fires mid-write\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, timeout=240)
+    assert proc.returncode == 23, proc.stderr.decode()[-800:]
+    # previous generation intact
+    obj, status = dstore.read_json(path, schema="launch_plan",
+                                   site="store.plan", root=root)
+    assert (obj, status) == ({"gen": 1}, "ok")
+    # the fsync'd tmp orphan is on disk until fsck/GC reclaims it
+    debris = [n for n in os.listdir(root) if n.startswith(".store_")]
+    assert len(debris) == 1
+    summary = dstore.fsck(root)
+    assert summary["tmp_removed"] == 1 and summary["corrupt"] == 0
+    assert [n for n in os.listdir(root)
+            if n.startswith(".store_")] == []
+
+
+# -- satellite 1: the planner fsync/truncation regression ---------------------
+
+def test_truncated_plan_is_a_cache_miss_not_a_crash(tmp_path):
+    """Regression for the pre-seam PlanStore: a torn plan document made
+    json.loads raise inside _doc. Now it quarantines and replans."""
+    from delphi_tpu.parallel.planner import PlanStore
+    store = PlanStore(str(tmp_path))
+    store.save("fp0", "freq", {"chunks": [8]})
+    path = str(tmp_path / "fp0.json")
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # tear mid-envelope
+    fresh = PlanStore(str(tmp_path))   # no warm in-memory copy
+    assert fresh.load("fp0", "freq") is None
+    assert dstore.quarantine_count(str(tmp_path)) == 1
+    # replanning overwrites cleanly and the next store reloads it
+    fresh.save("fp0", "freq", {"chunks": [16]})
+    assert PlanStore(str(tmp_path)).load("fp0", "freq") == {"chunks": [16]}
+
+
+# -- quota GC -----------------------------------------------------------------
+
+def _fill(root, name, nbytes, age_s, now):
+    path = os.path.join(root, name)
+    dstore.write_bytes(path, b"x" * nbytes, schema="model_ckpt",
+                       site="store.model", root=root)
+    os.utime(path, (now - age_s, now - age_s))
+    return path
+
+
+def test_gc_evicts_lru_until_under_quota_and_respects_protect(tmp_path):
+    root = str(tmp_path)
+    now = time.time()
+    old = _fill(root, "cold.bin", 4000, 500, now)
+    protected = _fill(root, "warm/keep.bin", 4000, 400, now)
+    young = _fill(root, "hot.bin", 4000, 5, now)
+    # three ~4 KB artifacts against a 9 KB quota: exactly one must go,
+    # and LRU order says it is the coldest unprotected file
+    rec = obs.start_recording("store.gc")
+    try:
+        summary = dstore.gc_sweep(
+            root, quota=9000, protect=[os.path.join(root, "warm")], now=now)
+    finally:
+        obs.stop_recording(rec)
+    assert summary["evicted_files"] == 1
+    assert not os.path.exists(old)          # oldest unprotected goes first
+    assert os.path.exists(protected)        # protect prefix survives
+    assert os.path.exists(young)            # newest survives under quota
+    counters = rec.registry.snapshot()["counters"]
+    assert counters["store.gc.sweeps"] == 1
+    assert counters["store.gc.evicted_files"] == 1
+
+
+def test_gc_removes_only_stale_tmp_debris(tmp_path):
+    root = str(tmp_path)
+    now = time.time()
+    stale = os.path.join(root, ".store_orphan")
+    live = os.path.join(root, ".store_inflight")
+    for p, age in ((stale, 300), (live, 1)):
+        with open(p, "wb") as f:
+            f.write(b"partial")
+        os.utime(p, (now - age, now - age))
+    summary = dstore.gc_sweep(root, quota=1 << 30, now=now)
+    assert summary["tmp_removed"] == 1
+    assert not os.path.exists(stale)
+    assert os.path.exists(live)  # a writer may still own it
+
+
+def test_gc_lock_excludes_concurrent_sweepers(tmp_path):
+    root = str(tmp_path)
+    lock = os.path.join(root, ".store_gc.lock")
+    with open(lock, "w") as f:
+        f.write("held\n")
+    rec = obs.start_recording("store.lock")
+    try:
+        summary = dstore.gc_sweep(root, quota=100)
+    finally:
+        obs.stop_recording(rec)
+    assert summary == {"skipped": "locked"}
+    assert rec.registry.snapshot()["counters"]["store.gc.lock_busy"] == 1
+    # a stale lock (older than DELPHI_STORE_GC_LOCK_STALE_S) is broken
+    os.environ["DELPHI_STORE_GC_LOCK_STALE_S"] = "1"
+    os.utime(lock, (time.time() - 900, time.time() - 900))
+    summary = dstore.gc_sweep(root, quota=1 << 30)
+    assert "skipped" not in summary
+    assert not os.path.exists(lock)  # released after the sweep
+
+
+def test_gc_never_evicts_quarantine(tmp_path):
+    root = str(tmp_path)
+    path = os.path.join(root, "bad.json")
+    dstore.write_json(path, {"v": 1}, schema="run_report",
+                      site="store.report", root=root)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:10])
+    assert dstore.read_json(path, schema="run_report",
+                            site="store.report", root=root)[1] == "corrupt"
+    assert dstore.quarantine_count(root) == 1
+    dstore.gc_sweep(root, quota=0, now=time.time())  # evict EVERYTHING else
+    assert dstore.quarantine_count(root) == 1  # evidence survives
+
+
+def test_env_quota_arms_automatic_post_write_gc(tmp_path):
+    """DELPHI_STORE_QUOTA_GB (fractional GB) + a zero sweep interval: the
+    maybe_gc ride-along after a seam write must evict the cold artifact
+    on its own, no explicit gc_sweep call anywhere."""
+    root = str(tmp_path)
+    cold = os.path.join(root, "cold.json")
+    hot = os.path.join(root, "hot.json")
+    dstore.write_json(cold, {"blob": "x" * 4096}, schema="plan",
+                      site="store.plan", root=root)
+    old = time.time() - 3600
+    os.utime(cold, (old, old))
+    os.environ["DELPHI_STORE_QUOTA_GB"] = "1e-6"  # ~1073 bytes
+    os.environ["DELPHI_STORE_GC_INTERVAL_S"] = "0"
+    dstore.reset_gc_state()
+    dstore.write_json(hot, {"ok": 1}, schema="plan", site="store.plan",
+                      root=root)
+    assert not os.path.exists(cold)
+    payload, status = dstore.read_json(hot, schema="plan",
+                                       site="store.plan", root=root)
+    assert status == "ok" and payload == {"ok": 1}
+
+
+def test_concurrent_writers_and_gc_on_one_root(tmp_path):
+    """A writer thread hammering the root while sweeps run concurrently:
+    no exceptions, and the final artifact reads back valid."""
+    root = str(tmp_path)
+    errors = []
+
+    def writer():
+        try:
+            for i in range(30):
+                dstore.write_json(os.path.join(root, "doc.json"),
+                                  {"i": i}, schema="run_report",
+                                  site="store.report", root=root)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for _ in range(10):
+        dstore.gc_sweep(root, quota=1 << 30)
+    t.join()
+    assert errors == []
+    obj, status = dstore.read_json(os.path.join(root, "doc.json"),
+                                   schema="run_report",
+                                   site="store.report", root=root)
+    assert status == "ok" and obj == {"i": 29}
+
+
+# -- fsck ---------------------------------------------------------------------
+
+def test_fsck_buckets_ok_legacy_corrupt_and_repairs(tmp_path):
+    root = str(tmp_path)
+    dstore.write_json(os.path.join(root, "good.json"), {"v": 1},
+                      schema="run_report", site="store.report", root=root)
+    with open(os.path.join(root, "old.json"), "w") as f:
+        json.dump({"pre": "seam"}, f)
+    bad = os.path.join(root, "torn.json")
+    dstore.write_json(bad, {"v": 2}, schema="launch_plan",
+                      site="store.plan", root=root)
+    blob = open(bad, "rb").read()
+    with open(bad, "wb") as f:
+        f.write(blob[:-4])
+    with open(os.path.join(root, ".store_orphan"), "wb") as f:
+        f.write(b"junk")
+    os.utime(os.path.join(root, ".store_orphan"),
+             (time.time() - 300,) * 2)
+
+    report_only = dstore.fsck(root, repair=False)
+    assert report_only["corrupt"] == 1 and report_only["quarantined"] == 0
+    assert os.path.exists(bad)  # report-only moves nothing
+
+    summary = dstore.fsck(root)
+    assert summary["ok"] == 1 and summary["legacy"] == 1
+    assert summary["corrupt"] == 1 and summary["quarantined"] == 1
+    assert summary["tmp_removed"] == 1
+    assert summary["per_store"]["run_report"]["ok"] == 1
+    assert summary["per_store"]["launch_plan"]["corrupt"] == 1
+    assert summary["per_store"]["(legacy)"]["legacy"] == 1
+    assert not os.path.exists(bad)
+    assert dstore.quarantine_count(root) == 1
+    # second pass is clean and stable
+    again = dstore.fsck(root)
+    assert again["corrupt"] == 0 and again["quarantine_files"] == 1
+
+
+def test_fsck_cli_exit_codes(tmp_path):
+    root = str(tmp_path)
+    dstore.write_json(os.path.join(root, "good.json"), {"v": 1},
+                      schema="run_report", site="store.report", root=root)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "delphi_tpu.main", "--fsck", root],
+        env=env, capture_output=True, timeout=240)
+    assert clean.returncode == 0, clean.stderr.decode()[-800:]
+    assert json.loads(clean.stdout)["corrupt"] == 0
+
+    bad = os.path.join(root, "torn.json")
+    dstore.write_json(bad, {"v": 2}, schema="launch_plan",
+                      site="store.plan", root=root)
+    blob = open(bad, "rb").read()
+    with open(bad, "wb") as f:
+        f.write(blob[:-2])
+    dirty = subprocess.run(
+        [sys.executable, "-m", "delphi_tpu.main", "--fsck", root],
+        env=env, capture_output=True, timeout=240)
+    assert dirty.returncode == 4, dirty.stderr.decode()[-800:]
+    assert json.loads(dirty.stdout)["corrupt"] == 1
+
+
+# -- snapshot manifest chains -------------------------------------------------
+
+def test_manifest_chain_archives_and_compacts(tmp_path):
+    from delphi_tpu.incremental import manifest as mf
+    snap = str(tmp_path / "snap")
+    ids = []
+    for gen in range(4):
+        mf.write_snapshot(snap, {"version": mf.MANIFEST_VERSION,
+                                 "snapshot_id": f"{gen:016x}",
+                                 "n_rows": 3}, {"gen": gen})
+        ids.append(f"{gen:016x}")
+    chain = mf.chain_files(snap)
+    assert len(chain) == 3  # three superseded generations archived
+    cur = mf.load_manifest(snap)
+    assert cur["snapshot_id"] == ids[-1]
+    assert cur["parent_snapshot_id"] == ids[-2]
+    # compaction trims oldest-first down to keep
+    os.environ["DELPHI_SNAPSHOT_CHAIN_KEEP"] = "1"
+    removed = mf.compact_chain(snap)
+    assert removed == 2 and len(mf.chain_files(snap)) == 1
+    assert mf.compact_chain(snap, keep=0) == 1
+    assert mf.chain_files(snap) == []
+    # the live manifest itself is never part of the chain
+    assert mf.load_manifest(snap)["snapshot_id"] == ids[-1]

@@ -256,3 +256,83 @@ def test_planner_shim_allowlist_is_minimal():
     pkg_root = OPS_DIR.parent
     for rel in _PLANNER_SHIMS:
         assert (pkg_root / rel).is_file()
+
+
+# ---------------------------------------------------------------------------
+# Durable-store seam: every artifact persisted by the package must flow
+# through parallel/store.py (envelope framing, fsync + rename + dir-fsync,
+# validated reads, quarantine, quota GC). A raw os.replace / json.dump /
+# pickle.dump / tempfile.mkstemp added anywhere else is a writer the
+# torn-write chaos matrix cannot reach and fsck cannot audit — exactly the
+# class of bug the seam exists to close.
+
+# functions allowed to keep raw rename-into-place semantics, with a reason:
+#   dist_resilience.touch_liveness_file — liveness stamps carry no payload;
+#   their mtime IS the signal, and staleness/corruption already reads as
+#   "dead member", so envelope validation would add nothing
+_RAW_PERSISTENCE_ALLOWED_FUNCS = {
+    ("parallel/dist_resilience.py", "touch_liveness_file"),
+}
+
+_PERSISTENCE_CALLS = {"replace", "dump", "mkstemp"}
+
+
+def test_raw_persistence_routes_through_store_seam():
+    import ast
+
+    pkg_root = OPS_DIR.parent
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = str(path.relative_to(pkg_root)).replace("\\", "/")
+        if rel == "parallel/store.py":
+            continue
+        tree = ast.parse(path.read_text())
+        allowed_spans = [
+            (node.lineno, node.end_lineno or node.lineno)
+            for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and (rel, node.name) in _RAW_PERSISTENCE_ALLOWED_FUNCS]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            owner = fn.value.id if isinstance(fn.value, ast.Name) else ""
+            if fn.attr not in _PERSISTENCE_CALLS \
+                    or owner not in ("os", "json", "pickle", "tempfile"):
+                continue
+            if any(lo <= node.lineno <= hi for lo, hi in allowed_spans):
+                continue
+            offenders.append(f"{rel}:{node.lineno}: {owner}.{fn.attr}(...)")
+    assert not offenders, (
+        "raw persistence call outside the parallel/store.py seam (use "
+        "store.write_json/write_pickle/write_bytes/replace_file so envelope "
+        "validation, quarantine, fault injection and quota GC cover it):\n"
+        + "\n".join(offenders))
+
+
+def test_raw_persistence_allowlist_is_minimal():
+    import ast
+
+    pkg_root = OPS_DIR.parent
+    for rel, func in _RAW_PERSISTENCE_ALLOWED_FUNCS:
+        path = pkg_root / rel
+        assert path.is_file(), f"stale allowlist entry: {rel}"
+        names = {node.name for node in ast.walk(ast.parse(path.read_text()))
+                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        assert func in names, f"stale allowlist entry: {rel}:{func}"
+
+
+def test_store_sites_are_registered_fault_sites():
+    """STORE_SITES (the torn-write chaos matrix) and SCHEMA_SITES (fsck's
+    tag->site mapping) must stay inside resilience.KNOWN_SITES, or a
+    DELPHI_FAULT_PLAN targeting a store site would warn "matches no
+    registered guarded site" and never fire."""
+    from delphi_tpu.parallel.resilience import KNOWN_SITES
+    from delphi_tpu.parallel.store import SCHEMA_SITES, STORE_SITES
+
+    assert set(STORE_SITES) <= set(KNOWN_SITES), (
+        sorted(set(STORE_SITES) - set(KNOWN_SITES)))
+    assert set(SCHEMA_SITES.values()) <= set(KNOWN_SITES), (
+        sorted(set(SCHEMA_SITES.values()) - set(KNOWN_SITES)))
