@@ -348,7 +348,13 @@ def smoke() -> int:
     rc = fleet_chaos_smoke()
     if rc:
         return rc
-    return store_chaos_smoke(df)
+    rc = store_chaos_smoke(df)
+    if rc:
+        return rc
+    rc = stream_smoke()
+    if rc:
+        return rc
+    return stream_chaos_smoke()
 
 
 def _smoke_frame():
@@ -960,6 +966,44 @@ def _incremental_frames(n: int = 64):
              for j in range(max(4, n // 10))]
     appended = pd.concat([base, pd.DataFrame(extra)], ignore_index=True)
     return base, appended
+
+
+def _stream_frames(n: int = 36, chunks: int = 3):
+    """Deterministic stream fixture: one table cut into sequential chunks.
+
+    Same shape discipline as `_incremental_frames`, but sized for chunked
+    ingestion: rows belong to one of 8 groups (``c0``), ``c1``/``c3`` are
+    pure functions of the group id, every 11th row nulls ``c1``. With 8
+    groups and chunk sizes >= 12, EVERY chunk carries at least one clean
+    (non-null) example of every group — which is what makes the streamed
+    end-state bit-identical to one batch run over the concatenation: a
+    model trained on any accumulated prefix learns the same c0 -> c1
+    mapping the full-table model learns. (A chunk missing a group, or
+    holding only a nulled example of it, lets an early model freeze a
+    wrong decision the batch run would never make.) Returns
+    ``(full, parts)``: the concatenated table and its ordered chunks."""
+    import numpy as np
+    import pandas as pd
+
+    def row(i, gid, null_c1=False):
+        return {"tid": str(i), "c0": f"g{gid}",
+                "c1": None if null_c1 else f"v{gid % 7}",
+                "c2": str((i * 7) % 5), "c3": f"w{gid % 5}"}
+
+    full = pd.DataFrame(
+        [row(i, i % 8, null_c1=(i % 11 == 0)) for i in range(n)])
+    parts = [full.iloc[idx].reset_index(drop=True)
+             for idx in np.array_split(np.arange(n), chunks)]
+    assert all(len(p) >= 12 for p in parts), \
+        "stream fixture chunks too small to cover every group cleanly"
+    return full, parts
+
+
+def _as_stream_table(frame):
+    """Column-major JSON table body, the /repair wire shape."""
+    split = json.loads(frame.to_json(orient="split"))
+    return {c: [row[i] for row in split["data"]]
+            for i, c in enumerate(split["columns"])}
 
 
 def incremental_smoke(n: int = 64, min_speedup: float = 0.0) -> int:
@@ -1994,6 +2038,399 @@ def store_chaos() -> int:
     return store_chaos_smoke(_smoke_frame())
 
 
+def stream_smoke(n: int = 36, chunks: int = 3) -> int:
+    """Streaming repair plane A/B over a live RepairServer.
+
+    1. a batch /repair over the full concatenated table establishes the
+       reference frame;
+    2. the same table streams in as `chunks` chained deltas (each request
+       cites the previous response's snapshot id), measuring sustained
+       rows/s across the acknowledged commits;
+    3. the FINAL delta's frame must be BIT-IDENTICAL to the batch run
+       (same wire serialization, canonical ordering) with the provenance
+       splice engaged (`cells_spliced_reused > 0` in the delta summary);
+    4. protocol checks ride along: a re-sent final delta is acknowledged
+       as an idempotent duplicate carrying the committed frame, a
+       same-seq delta with different content is a 409 conflict with the
+       cursor echoed, and /metrics reports the pre-seeded `stream.*`
+       counters plus the `stream.lag_rows` staleness gauge.
+
+    Prints one JSON line; exit code 1 on failure."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from delphi_tpu.observability.serve import RepairServer
+
+    full, parts = _stream_frames(n, chunks)
+    cache_dir = tempfile.mkdtemp(prefix="delphi_stream_smoke_")
+
+    # stream requests arm a per-request provenance ledger server-side, so
+    # the splice stamps (reused/recomputed) are real without any env setup
+    srv = RepairServer(port=0, workers=2, cache_dir=cache_dir).start()
+    ok = False
+    info = {}
+    try:
+        def post(body, timeout=600):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/repair",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        _heartbeat("stream smoke batch reference")
+        st_ref, ref = post({"table": _as_stream_table(full), "row_id": "tid",
+                            "deadline_s": 600, "request_id": "ref"})
+
+        _heartbeat(f"stream smoke: {chunks} chained deltas")
+        statuses, parent, final = [], None, {}
+        t0 = time.monotonic()
+        for seq, part in enumerate(parts, start=1):
+            st, body = post({
+                "table": _as_stream_table(part), "row_id": "tid",
+                "deadline_s": 600, "request_id": f"delta-{seq}",
+                "stream": {"id": "bench", "seq": seq,
+                           "parent_snapshot": parent}})
+            statuses.append(st)
+            if st == 200:
+                parent = (body.get("cursor") or {}).get("snapshot_id")
+                final = body
+        stream_elapsed = time.monotonic() - t0
+        rows_per_s = len(full) / stream_elapsed if stream_elapsed else 0.0
+
+        # idempotent re-send of the head delta: at-least-once delivery
+        # after a failover must re-ack with the committed frame
+        _heartbeat("stream smoke duplicate re-send")
+        st_dup, dup = post({
+            "table": _as_stream_table(parts[-1]), "row_id": "tid",
+            "deadline_s": 600, "request_id": "dup",
+            "stream": {"id": "bench", "seq": chunks}})
+        # same seq, different content: must refuse with the cursor echoed
+        mutated = parts[-1].copy()
+        mutated["c2"] = [str((i * 3) % 7) for i in range(len(mutated))]
+        st_conflict, conflict = post({
+            "table": _as_stream_table(mutated), "row_id": "tid",
+            "deadline_s": 600, "request_id": "conflict",
+            "stream": {"id": "bench", "seq": chunks}})
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+
+        def metric(name):
+            for line in metrics.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[1])
+            return None
+
+        summary = final.get("incremental") or {}
+        checks = {
+            "reference_ok": st_ref == 200,
+            "all_deltas_acked": statuses == [200] * chunks,
+            "frame_bit_identical":
+                bool(final.get("frame"))
+                and final.get("frame") == ref.get("frame"),
+            "provenance_spliced":
+                summary.get("mode") == "delta"
+                and summary.get("cells_spliced_reused", 0) > 0
+                and summary.get("models_reused", 0) >= 1,
+            "chain_advanced":
+                (final.get("cursor") or {}).get("seq") == chunks
+                and bool((final.get("cursor") or {}).get("snapshot_id")),
+            "duplicate_acked":
+                st_dup == 200 and dup.get("status") == "duplicate"
+                and dup.get("frame") == ref.get("frame"),
+            "conflict_refused":
+                st_conflict == 409 and conflict.get("status") == "conflict"
+                and (conflict.get("cursor") or {}).get("seq") == chunks,
+            "metrics_commits": metric("delphi_stream_commits") == chunks,
+            "metrics_duplicates":
+                (metric("delphi_stream_duplicates") or 0) >= 1,
+            "lag_gauge_reported":
+                metric("delphi_stream_lag_rows") is not None,
+        }
+        ok = all(checks.values())
+        info = {
+            "checks": checks, "statuses": statuses,
+            "rows_per_s": round(rows_per_s, 2),
+            "stream_elapsed_s": round(stream_elapsed, 3),
+            "lag_rows": metric("delphi_stream_lag_rows"),
+            "repairs": len(final.get("frame") or []),
+            "incremental": {k: summary.get(k) for k in
+                            ("mode", "models_reused",
+                             "cells_spliced_reused", "rows_planned")},
+        }
+    finally:
+        srv.drain(grace_s=10)
+
+    print(json.dumps({
+        "metric": "stream_smoke", "value": info.get("rows_per_s", 0),
+        "unit": "rows/s streamed", "vs_baseline": None, "ok": ok,
+        "rows": len(full), "chunks": chunks, **info,
+    }), flush=True)
+    if not ok:
+        print("stream smoke FAILED: a chunked stream must commit every "
+              "delta and land bit-identical to one batch run over the "
+              f"concatenated table ({info.get('checks')})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def stream() -> int:
+    """Standalone `bench.py --stream` entry: CPU backend, live
+    RepairServer, chained-delta vs batch A/B (see stream_smoke)."""
+    _force_cpu_backend()
+    return stream_smoke(
+        n=int(os.environ.get("DELPHI_BENCH_STREAM_ROWS", "36")),
+        chunks=int(os.environ.get("DELPHI_BENCH_STREAM_CHUNKS", "3")))
+
+
+def stream_chaos_smoke(n: int = 36, chunks: int = 3) -> int:
+    """Streaming chaos A/B: kill the chain's home worker and tear its
+    cursor write mid-stream; the stream must not lose an acknowledged
+    delta or change its answer.
+
+    1. a clean single-server batch run over the full concatenated table
+       establishes the reference frame;
+    2. a 2-worker fleet serves the chain — every delta routes by the
+       CHAIN fingerprint to the same rendezvous home
+       (`fleet.affinity.chain_hits`);
+    3. delta 2 carries `store.stream_cursor:1:torn_write` — the commit's
+       verified read-back must detect the torn cursor, retry, and still
+       acknowledge (`stream.commit_retries` fires, nothing lost);
+    4. the FINAL delta carries a rank-scoped rank_death plan for the
+       chain's home: the worker dies mid-repair before the commit, the
+       router evicts it and re-dispatches to the survivor, which rebuilds
+       the session from the durable cursor through the shared cache root
+       (`stream.recoveries` on the survivor) and commits — the response
+       frame must be BIT-IDENTICAL to the batch reference;
+    5. a duplicate re-send of the final delta confirms the survivor holds
+       the full chain (idempotent ack, same frame).
+
+    Prints one JSON line; exit code 1 on failure."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from delphi_tpu.observability.fleet import FleetRouter, rendezvous_rank
+    from delphi_tpu.observability.serve import RepairServer, chain_fingerprint
+
+    full, parts = _stream_frames(n, chunks)
+    sid = "chaos"
+
+    # same knob shape as fleet_chaos_smoke: the guarded device domain
+    # route puts xfer.upload on the hot path for the kill plan
+    os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    prev_cc = os.environ.get("DELPHI_COMPILE_CACHE_DIR")
+
+    def post(port, path, body, timeout=600):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+        except Exception as e:  # dropped request — the A/B forbids these
+            return None, {"error": f"{type(e).__name__}: {e}"}
+
+    def fetch(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            raw = r.read()
+        return raw.decode() if path == "/metrics" else json.loads(raw)
+
+    def metric(text, name):
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[1])
+        return 0.0
+
+    _heartbeat("stream chaos reference (clean single server)")
+    ref_cache = tempfile.mkdtemp(prefix="delphi_stream_ref_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(ref_cache,
+                                                          "compile")
+    srv = RepairServer(port=0, workers=2, cache_dir=ref_cache).start()
+    try:
+        st_ref, ref = post(srv.port, "/repair",
+                           {"table": _as_stream_table(full), "row_id": "tid",
+                            "deadline_s": 600, "request_id": "ref"})
+    finally:
+        srv.drain(grace_s=10)
+
+    _heartbeat("stream chaos fleet start (2 workers)")
+    fleet_cache = tempfile.mkdtemp(prefix="delphi_stream_chaos_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(fleet_cache,
+                                                          "compile")
+    router = FleetRouter(
+        port=0, workers=2, cache_dir=fleet_cache, heartbeat_s=0.5,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": None,
+            "DELPHI_MESH": "off",
+            "DELPHI_FLEET_HEARTBEAT_S": "0.5",
+        })
+    ok = False
+    info = {}
+    try:
+        router.start()
+        live = router.refresh_membership()
+        chain_fp = chain_fingerprint({"stream": {"id": sid}})
+        victim = rendezvous_rank(chain_fp, live)[0]
+        survivor = next(w for w in live if w != victim)
+
+        def delta(seq, part, parent, fault_plan=None, request_id=None):
+            body = {"table": _as_stream_table(part), "row_id": "tid",
+                    "deadline_s": 600,
+                    "request_id": request_id or f"delta-{seq}",
+                    "stream": {"id": sid, "seq": seq,
+                               "parent_snapshot": parent}}
+            if fault_plan:
+                body["fault_plan"] = fault_plan
+            return post(router.port, "/repair", body)
+
+        statuses, parent = {}, None
+        _heartbeat("stream chaos delta 1 (clean)")
+        statuses[1], body1 = delta(1, parts[0], parent)
+        parent = (body1.get("cursor") or {}).get("snapshot_id")
+
+        _heartbeat("stream chaos delta 2 (torn cursor write)")
+        statuses[2], body2 = delta(
+            2, parts[1], parent,
+            fault_plan="store.stream_cursor:1:torn_write")
+        parent = (body2.get("cursor") or {}).get("snapshot_id")
+
+        # the torn-write retry counter lives on the chain's home worker —
+        # snapshot every worker's metrics NOW, before the kill takes the
+        # home (and its counters) down with it
+        pre_kill = {}
+        for wid, reg in router._read_registrations().items():
+            try:
+                pre_kill[wid] = fetch(reg["port"], "/metrics")
+            except Exception:
+                pre_kill[wid] = ""
+
+        kill_plan = f"{victim}:xfer.upload:1:rank_death"
+        _heartbeat(f"stream chaos final delta (kill worker {victim})")
+        statuses[3], body3 = delta(chunks, parts[-1], parent,
+                                   fault_plan=kill_plan)
+
+        _heartbeat("stream chaos duplicate re-send to the survivor")
+        st_dup, dup = delta(chunks, parts[-1], None, request_id="dup")
+
+        regs = router._read_registrations()
+        worker_metrics = {}
+        for wid, reg in regs.items():
+            try:
+                worker_metrics[wid] = fetch(reg["port"], "/metrics")
+            except Exception:
+                worker_metrics[wid] = ""
+        router_metrics = fetch(router.port, "/metrics")
+
+        def across_workers(name):
+            return sum(metric(m, name) for m in worker_metrics.values())
+
+        checks = {
+            "reference_ok": st_ref == 200,
+            "zero_lost": all(statuses.get(s) == 200
+                             for s in (1, 2, 3)) and st_dup == 200,
+            "chain_affinity":
+                metric(router_metrics, "delphi_fleet_affinity_chain_hits")
+                >= 2,
+            "torn_cursor_retried":
+                sum(metric(m, "delphi_stream_commit_retries")
+                    for m in pre_kill.values()) >= 1
+                and body2.get("status") == "ok",
+            "victim_process_dead":
+                router._procs[victim].poll() is not None,
+            "evicted_and_redispatched":
+                metric(router_metrics, "delphi_fleet_evictions") >= 1
+                and metric(router_metrics, "delphi_fleet_redispatches") >= 1,
+            "survivor_recovered":
+                across_workers("delphi_stream_recoveries") >= 1,
+            "frame_bit_identical":
+                bool(body3.get("frame"))
+                and body3.get("frame") == ref.get("frame"),
+            "cursor_at_head":
+                (body3.get("cursor") or {}).get("seq") == chunks
+                and (body3.get("cursor") or {}).get("rows_total")
+                == len(full),
+            "duplicate_on_survivor":
+                dup.get("status") == "duplicate"
+                and dup.get("frame") == ref.get("frame"),
+        }
+        ok = all(checks.values())
+        info = {
+            "victim": victim, "survivor": survivor,
+            "kill_plan": kill_plan, "checks": checks,
+            "statuses": {str(k): v for k, v in statuses.items()},
+            "stream": {
+                "commit_retries":
+                    sum(metric(m, "delphi_stream_commit_retries")
+                        for m in pre_kill.values()),
+                "recoveries": across_workers("delphi_stream_recoveries"),
+                "commits": across_workers("delphi_stream_commits"),
+                "duplicates": across_workers("delphi_stream_duplicates"),
+            },
+            "fleet": {
+                "chain_hits": metric(router_metrics,
+                                     "delphi_fleet_affinity_chain_hits"),
+                "evictions": metric(router_metrics,
+                                    "delphi_fleet_evictions"),
+                "redispatches": metric(router_metrics,
+                                       "delphi_fleet_redispatches"),
+            },
+        }
+    finally:
+        router.drain()
+        os.environ.pop("DELPHI_DOMAIN_DEVICE", None)
+        os.environ.pop("DELPHI_RETRY_BASE_S", None)
+        os.environ.pop("DELPHI_COMPILE_CACHE_MIN_S", None)
+        if prev_cc is None:
+            os.environ.pop("DELPHI_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["DELPHI_COMPILE_CACHE_DIR"] = prev_cc
+
+    print(json.dumps({
+        "metric": "stream_chaos_smoke", "value": 1 if ok else 0,
+        "unit": "pass", "vs_baseline": None, "ok": ok, **info,
+    }), flush=True)
+    if not ok:
+        print("stream chaos smoke FAILED: a worker kill + torn cursor "
+              "mid-stream must resume from the durable cursor on the "
+              "survivor with zero acknowledged deltas lost and the end-"
+              f"state bit-identical ({info.get('checks')})",
+              file=sys.stderr)
+        for wid in sorted(getattr(router, "_procs", {})):
+            try:
+                with open(router._worker_log_path(wid)) as f:
+                    tail = f.read()[-2000:]
+                print(f"--- fleet worker {wid} log tail ---\n{tail}",
+                      file=sys.stderr)
+            except OSError:
+                pass
+        return 1
+    return 0
+
+
+def stream_chaos() -> int:
+    """Standalone `bench.py --stream-chaos` entry: CPU backend, 2-worker
+    fleet, home-worker kill + torn cursor write mid-stream (see
+    stream_chaos_smoke)."""
+    _force_cpu_backend()
+    return stream_chaos_smoke()
+
+
 _READY_SENTINEL = "BENCH_BACKEND_READY"
 
 # On-chip measurements persist here keyed by workload@scale: the axon tunnel
@@ -2279,6 +2716,27 @@ def main() -> None:
                              "plus fleet-registration tear and subprocess "
                              "crash scenarios, asserting bit-identical "
                              "frames throughout; exits 1 on failure")
+    parser.add_argument("--stream", dest="stream", action="store_true",
+                        help="streaming repair plane A/B on the CPU "
+                             "backend: the smoke table streamed as chained "
+                             "deltas against a live RepairServer vs one "
+                             "batch run over the concatenation, asserting "
+                             "a bit-identical end-state (frame + "
+                             "provenance splice), idempotent duplicate "
+                             "acks, 409 conflicts, sustained rows/s and "
+                             "the stream.lag_rows gauge; exits 1 on "
+                             "failure")
+    parser.add_argument("--stream-chaos", dest="stream_chaos",
+                        action="store_true",
+                        help="streaming chaos A/B on the CPU backend: a "
+                             "2-worker fleet serves a chained stream, the "
+                             "chain's home worker is killed mid-delta and "
+                             "a cursor write is torn mid-stream, asserting "
+                             "the stream resumes from the last durable "
+                             "cursor on the survivor with zero "
+                             "acknowledged deltas lost and the end-state "
+                             "bit-identical to a batch run; exits 1 on "
+                             "failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -2309,6 +2767,12 @@ def main() -> None:
 
     if args.store_chaos:
         sys.exit(store_chaos())
+
+    if args.stream:
+        sys.exit(stream())
+
+    if args.stream_chaos:
+        sys.exit(stream_chaos())
 
     if args._child:
         _child_main(args)

@@ -21,6 +21,11 @@ what changed":
   moved, and the new per-cell decisions splice into the prior result frame
   and provenance ledger (each spliced cell stamped ``reused`` /
   ``recomputed``).
+* :mod:`~delphi_tpu.incremental.stream` — the streaming repair plane:
+  chained delta ingestion with a per-stream durable cursor (generational,
+  written through the store seam with verified read-back), idempotent
+  re-apply, bounded-staleness backpressure, and drift-gated background
+  retrains swapped atomically into the snapshot state.
 
 See docs/source/incremental.rst.
 """
@@ -33,3 +38,6 @@ from delphi_tpu.incremental.manifest import (  # noqa: F401
     merge_manifests, write_snapshot,
 )
 from delphi_tpu.incremental.planner import DeltaPlan, plan_delta  # noqa: F401
+from delphi_tpu.incremental.stream import (  # noqa: F401
+    StreamBusy, StreamCommitError, StreamManager, StreamSession,
+)

@@ -163,7 +163,11 @@ def _splice_frames(model: Any, table: EncodedTable,
 def _save_snapshot(model: Any, table: EncodedTable, directory: str,
                    digest: str, frame: pd.DataFrame,
                    models: Optional[Any],
-                   ledger_entries: Optional[List[Dict[str, Any]]]) -> None:
+                   ledger_entries: Optional[List[Dict[str, Any]]]
+                   ) -> Optional[str]:
+    """Returns the written snapshot id — the chain head a streaming
+    client's next delta must cite as its parent — or None when
+    persistence failed (best-effort, never fails the run)."""
     try:
         manifest = mf.build_manifest(table, options_digest=digest)
         state = {
@@ -173,9 +177,11 @@ def _save_snapshot(model: Any, table: EncodedTable, directory: str,
         }
         mf.write_snapshot(directory, manifest, state)
         counter_inc("incremental.snapshots_written")
+        return manifest.get("snapshot_id")
     except Exception as e:
         # snapshot persistence must never fail the run that produced it
         _logger.warning(f"Failed to write snapshot to {directory}: {e}")
+        return None
 
 
 def run_incremental(model: Any, table: EncodedTable, input_name: str,
@@ -195,13 +201,16 @@ def run_incremental(model: Any, table: EncodedTable, input_name: str,
         df, elapsed = model._run(table, input_name, continuous_columns,
                                  *run_flags)
         plain_mode = not any(run_flags)
+        snapshot_id = None
         if directory and plain_mode and not table.process_local:
             led = active_ledger()
-            _save_snapshot(model, table, directory, digest, df,
-                           getattr(model, "_last_models", None),
-                           led.entries() if led is not None else None)
+            snapshot_id = _save_snapshot(
+                model, table, directory, digest, df,
+                getattr(model, "_last_models", None),
+                led.entries() if led is not None else None)
         summary = {"mode": "full", "fallback_reason": reason,
-                   "snapshot_dir": directory or None}
+                   "snapshot_dir": directory or None,
+                   "snapshot_id": snapshot_id}
         _publish(summary)
         return df, elapsed, summary
 
@@ -267,7 +276,10 @@ def run_incremental(model: Any, table: EncodedTable, input_name: str,
                cells_reused=reused, cells_recomputed=recomputed)
         summary.update({"models_reused": 0, "models_retrained": 0,
                         "cells_spliced_reused": reused,
-                        "cells_recomputed": recomputed})
+                        "cells_recomputed": recomputed,
+                        # snapshot untouched: the prior head stays the
+                        # chain head a streaming client must cite
+                        "snapshot_id": manifest.get("snapshot_id")})
         _publish(summary)
         return df, time.monotonic() - started, summary
 
@@ -299,13 +311,14 @@ def run_incremental(model: Any, table: EncodedTable, input_name: str,
     # spliced ledger — over the CURRENT table's manifest
     merged_models = dict(prior_models)
     merged_models.update(sub_models)
-    _save_snapshot(model, table, directory, digest, df, merged_models,
-                   merged_entries)
+    snapshot_id = _save_snapshot(model, table, directory, digest, df,
+                                 merged_models, merged_entries)
 
     _count(plan, models_reused=len(models_reused),
            models_retrained=len(models_retrained),
            cells_reused=reused, cells_recomputed=recomputed)
-    summary.update({"models_reused": len(models_reused),
+    summary.update({"snapshot_id": snapshot_id,
+                    "models_reused": len(models_reused),
                     "models_retrained": len(models_retrained),
                     "models_reused_attrs": models_reused,
                     "cells_spliced_reused": reused,

@@ -15,7 +15,10 @@ run report (exit code 3 when `--drift-fail-over` trips).
 Incremental mode: `--incremental --snapshot-dir D` diffs the input against
 the snapshot manifest in D, repairs only the delta (reusing undrifted
 per-attribute models and prior per-cell decisions), and updates the
-snapshot; the first run populates it. See docs/source/incremental.rst.
+snapshot; the first run populates it. `--stream N --snapshot-dir D`
+ingests the input as N chained deltas against a durable per-stream cursor
+in D — crash-exact resume, end state bit-identical to one batch run.
+See docs/source/incremental.rst.
 
 Service mode: `--serve [--serve-port P] [--serve-cache-dir D]` skips the
 batch arguments entirely and runs the persistent repair service
@@ -35,6 +38,70 @@ import pandas as pd
 from delphi_tpu import delphi
 from delphi_tpu.errors import ConstraintErrorDetector, NullErrorDetector
 from delphi_tpu.session import get_session
+
+
+def _stream_batch(args, session) -> int:
+    """``--stream N``: drive the input through a local
+    :class:`~delphi_tpu.incremental.stream.StreamSession` as N chained
+    deltas. Each chunk cites the previous commit's snapshot id as its
+    parent; the durable cursor under ``--snapshot-dir`` makes a killed
+    run resume at the last committed chunk (already-committed chunks
+    acknowledge as idempotent duplicates). The written output is
+    bit-identical to one batch run over the whole input."""
+    import numpy as np
+
+    from delphi_tpu.incremental.stream import StreamSession
+
+    df = pd.read_csv(args.input,
+                     dtype=str if args.dtype == "str" else None)
+    chunks = np.array_split(np.arange(len(df)), max(1, args.stream))
+
+    detectors = [NullErrorDetector()]
+    if args.constraints:
+        detectors.append(
+            ConstraintErrorDetector(constraint_path=args.constraints))
+
+    def run_fn(accumulated, snap_dir, seq):
+        name = session.register(f"stream_input_{seq}",
+                                accumulated.copy())
+        try:
+            model = delphi.repair \
+                .setTableName(name) \
+                .setRowId(args.row_id) \
+                .setErrorDetectors(detectors) \
+                .setDiscreteThreshold(args.discrete_threshold) \
+                .option("repair.incremental", "true") \
+                .option("repair.snapshot.dir", snap_dir)
+            if args.targets:
+                model = model.setTargets(args.targets.split(","))
+            out = model.run()
+            return out, getattr(model, "_last_incremental", None)
+        finally:
+            session.drop(name)
+
+    sess = StreamSession("cli", args.snapshot_dir)
+    parent = (sess.durable_cursor() or {}).get("snapshot_id")
+    result = None
+    for seq, idx in enumerate(chunks, start=1):
+        delta = df.iloc[idx].reset_index(drop=True)
+        status, body = sess.apply(seq, parent, delta, run_fn)
+        if status != 200:
+            print(f"stream chunk {seq}/{len(chunks)} failed "
+                  f"({status}): {body.get('error')}", file=sys.stderr)
+            return 1
+        cursor = body.get("cursor") or {}
+        parent = cursor.get("snapshot_id")
+        result = body.get("frame_df", result)
+        print(f"stream chunk {seq}/{len(chunks)} {body['status']}: "
+              f"{cursor.get('rows_total', 0)} rows durable at cursor "
+              f"seq {cursor.get('seq')}", file=sys.stderr)
+    if result is None:
+        print("stream produced no frame (all chunks were stale "
+              "duplicates?)", file=sys.stderr)
+        return 1
+    result.to_csv(args.output, index=False)
+    print(f"wrote {len(result)} rows to {args.output}", file=sys.stderr)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -191,6 +258,18 @@ def main(argv=None) -> int:
                              "prior run's frame/models/provenance. "
                              "Equivalent to DELPHI_SNAPSHOT_DIR / "
                              "repair.snapshot.dir")
+    parser.add_argument("--stream", dest="stream", type=int, default=0,
+                        metavar="N",
+                        help="streaming repair: split the input into N "
+                             "chunks and ingest them as a chained delta "
+                             "stream against the durable per-stream cursor "
+                             "under --snapshot-dir (each chunk chains on "
+                             "the previous snapshot id; a killed run "
+                             "re-invoked with the same arguments resumes "
+                             "at the last durable cursor with idempotent "
+                             "re-apply). The final output is bit-identical "
+                             "to one batch run over the whole input. See "
+                             "docs/source/incremental.rst (Streaming)")
     parser.add_argument("--escalate", dest="escalate", action="store_true",
                         help="confidence-routed escalation pass: cells the "
                              "statistical models are unsure about (posterior "
@@ -263,6 +342,13 @@ def main(argv=None) -> int:
     if not (args.input and args.row_id and args.output):
         parser.error("--input, --row-id and --output are required "
                      "(unless --serve)")
+    if args.stream > 0:
+        if not args.snapshot_dir:
+            parser.error("--stream requires --snapshot-dir (the stream's "
+                         "durable cursor + snapshot directory)")
+        if args.fault_plan:
+            session.conf["repair.fault.plan"] = args.fault_plan
+        return _stream_batch(args, session)
     recorder = None
     if args.metrics_port is not None:
         session.conf["repair.metrics.port"] = str(args.metrics_port)

@@ -66,7 +66,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from delphi_tpu.observability.registry import counter_inc, gauge_set
 from delphi_tpu.observability.serve import (
-    _knob_float, _knob_int, table_fingerprint,
+    _knob_float, _knob_int, chain_fingerprint, table_fingerprint,
 )
 from delphi_tpu.utils import setup_logger
 
@@ -83,6 +83,7 @@ _SEED_COUNTERS = (
     "fleet.evictions", "fleet.rejoins", "fleet.dispatch_faults",
     "fleet.all_shed", "fleet.no_workers",
     "fleet.affinity.hits", "fleet.affinity.misses",
+    "fleet.affinity.chain_hits",
     "fleet.registration_corrupt",
     "store.corrupt", "store.quarantined",
 )
@@ -487,7 +488,14 @@ class FleetRouter:
         from delphi_tpu.parallel import resilience
 
         counter_inc("fleet.requests")
-        fp = table_fingerprint(payload["table"], payload["row_id"])
+        # chained requests (a stream delta or a base_snapshot follow-up)
+        # route by the CHAIN-ROOT key, not the per-delta table content:
+        # every link of a chain must land on the home that holds its
+        # snapshot, durable cursor, and warm models — hashing the table
+        # would scatter the chain across the ring on every append
+        chain = chain_fingerprint(payload)
+        fp = chain or table_fingerprint(payload["table"],
+                                        payload["row_id"])
         data = json.dumps(payload).encode()
         tried: set = set()
         shed_retry_afters: List[float] = []
@@ -507,8 +515,11 @@ class FleetRouter:
             if hops > 1:
                 counter_inc("fleet.redispatches")
             # affinity: did this request land on its rendezvous home?
-            counter_inc("fleet.affinity.hits" if wid == ranked[0]
-                        else "fleet.affinity.misses")
+            if wid != ranked[0]:
+                counter_inc("fleet.affinity.misses")
+            else:
+                counter_inc("fleet.affinity.chain_hits" if chain
+                            else "fleet.affinity.hits")
             try:
                 status, body, headers = self._dispatch_once(
                     wid, data, self.dispatch_timeout_s)

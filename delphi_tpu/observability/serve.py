@@ -67,6 +67,16 @@ first request under a fresh id runs full and populates it. The id rides
 per-request MODEL OPTIONS (not env), so concurrent requests against
 different snapshots never race. The response echoes ``base_snapshot``
 and, when the delta path ran, an ``incremental`` summary.
+
+A ``"stream": {"id", "seq", "parent_snapshot"}`` field instead switches
+the request onto the continuous ingestion plane
+(:mod:`delphi_tpu.incremental.stream`): chained deltas accumulate into a
+per-stream table under the cache root with a durable commit cursor,
+idempotent re-apply, per-stream 429 backpressure with the cursor echoed,
+drift-gated background retrains, and ``/drain`` reporting every stream's
+resume point before admission closes. Fleets route chained requests by
+the chain-root fingerprint so a whole chain stays on (and fails over
+with) one home worker.
 """
 
 import hashlib
@@ -104,6 +114,34 @@ def table_fingerprint(table: Dict[str, Any], row_id: str) -> str:
     blob = json.dumps({"row_id": row_id, "table": table},
                       sort_keys=True, default=str)
     return hashlib.sha1(blob.encode()).hexdigest()
+
+
+def chain_fingerprint(payload: Dict[str, Any]) -> Optional[str]:
+    """Chain-root routing key for chained requests, or None for plain
+    ones. A stream's deltas (and a ``base_snapshot`` chain's follow-ups)
+    each carry a DIFFERENT table, so hashing the table would scatter the
+    chain across the fleet; hashing the chain root (stream id /
+    base_snapshot id) pins every link to the rendezvous home whose
+    snapshot, cursor, and warm models the chain built."""
+    stream = payload.get("stream")
+    if isinstance(stream, dict) and stream.get("id"):
+        return hashlib.sha1(
+            f"stream|{stream['id']}".encode()).hexdigest()
+    base = payload.get("base_snapshot")
+    if base:
+        return hashlib.sha1(f"chain|{base}".encode()).hexdigest()
+    return None
+
+
+def _stream_rows(payload: Dict[str, Any]) -> int:
+    """Row count of one delta payload — the unit ``stream.lag_rows``
+    (admitted-but-not-yet-durable staleness) is measured in."""
+    table = payload.get("table") or {}
+    try:
+        return max((len(v) for v in table.values()
+                    if isinstance(v, (list, tuple))), default=0)
+    except TypeError:
+        return 0
 
 
 def write_fleet_registration(fleet_dir: str, path: str,
@@ -152,6 +190,10 @@ _SEED_COUNTERS = (
     "store.corrupt", "store.quarantined", "store.torn_writes",
     "store.gc.sweeps", "store.gc.evicted_files", "store.gc.lock_busy",
     "store.chain_compacted", "resilience.faults.store_corrupt",
+    "stream.deltas", "stream.commits", "stream.duplicates",
+    "stream.conflicts", "stream.backpressure_429", "stream.commit_retries",
+    "stream.recoveries", "stream.retrain.triggers", "stream.retrain.swaps",
+    "stream.retrain.failed",
 )
 
 
@@ -166,13 +208,17 @@ def _knob_int(env: str, conf: str, default: int) -> int:
 
 
 class Rejection(Exception):
-    """An admission refusal carrying its HTTP mapping."""
+    """An admission refusal carrying its HTTP mapping. ``extra`` merges
+    into the response body — stream backpressure echoes the durable
+    cursor there, so a 429 tells the client exactly where to resume."""
 
     def __init__(self, status: int, reason: str,
-                 retry_after_s: Optional[float] = None) -> None:
+                 retry_after_s: Optional[float] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> None:
         self.status = int(status)
         self.reason = reason
         self.retry_after_s = retry_after_s
+        self.extra = extra or {}
         super().__init__(reason)
 
 
@@ -271,6 +317,9 @@ class RepairServer:
         self._active: Dict[str, RepairJob] = {}
         # table fingerprint -> (catalog name, EncodedTable)
         self._tables: Dict[str, Tuple[str, Any]] = {}
+        # chained delta ingestion (incremental/stream.py) — built in
+        # start() once the cache dir exists
+        self.streams: Optional[Any] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -325,6 +374,13 @@ class RepairServer:
         gauge_set("serve.queue_depth", 0)
         gauge_set("serve.in_flight", 0)
         gauge_set("serve.draining", 0)
+        gauge_set("stream.lag_rows", 0)
+        gauge_set("stream.active", 0)
+        gauge_set("stream.recovering", 0)
+        from delphi_tpu.incremental.stream import StreamManager
+        self.streams = StreamManager(
+            os.path.join(self.cache_dir, "streams"),
+            store_root=self.cache_dir)
         self._rebuild_warm_state()
 
         for i in range(self.workers):
@@ -578,10 +634,34 @@ class RepairServer:
             raise Rejection(400, f"bad deadline_s: {deadline_s!r}")
         deadline_at = (time.monotonic() + deadline_s
                        if deadline_s > 0 else None)
+        stream_req = payload.get("stream")
+        if stream_req is not None:
+            # per-stream backpressure BEFORE the shared queue: a stream
+            # past its in-flight bound gets 429 + the durable cursor so
+            # it resumes exactly where the server is, instead of queuing
+            # deltas the chain cannot admit yet
+            from delphi_tpu.incremental.stream import StreamBusy
+            if not isinstance(stream_req, dict) or not stream_req.get("id"):
+                raise Rejection(400, "stream must be an object with an "
+                                     "'id' and a 'seq'")
+            try:
+                self.streams.admit(stream_req["id"], _stream_rows(payload),
+                                   retry_after_s=self.retry_after_s)
+            except StreamBusy as b:
+                raise Rejection(
+                    429, f"stream {b.stream_id} backpressure: "
+                         "in-flight delta bound reached",
+                    retry_after_s=b.retry_after_s,
+                    extra={"cursor": b.cursor})
+            except ValueError as e:
+                raise Rejection(400, str(e))
         job = RepairJob(request_id, payload, deadline_at)
         try:
             self._queue.put_nowait(job)
         except queue.Full:
+            if stream_req is not None:
+                self.streams.release(stream_req.get("id"),
+                                     _stream_rows(payload))
             counter_inc("serve.shed")
             raise Rejection(429, "admission queue full",
                             retry_after_s=self.retry_after_s)
@@ -679,6 +759,9 @@ class RepairServer:
         shutil.rmtree(self._models_dir(fp), ignore_errors=True)
 
     def _execute(self, job: RepairJob) -> None:
+        if job.payload.get("stream") is not None:
+            self._execute_stream(job)
+            return
         from delphi_tpu.api import Delphi
         from delphi_tpu.errors import NullErrorDetector
         from delphi_tpu.observability import provenance
@@ -788,6 +871,175 @@ class RepairServer:
             histogram_observe("serve.request_seconds",
                               time.perf_counter() - t0)
 
+    def stream_cursors(self) -> Dict[str, Any]:
+        """Durable resume points for every stream under the cache root —
+        what /drain reports before closing admission."""
+        if self.streams is None:
+            return {}
+        return self.streams.durable_cursors()
+
+    def _execute_stream(self, job: RepairJob) -> None:
+        """One chained stream delta: accumulate → incremental repair
+        against the per-stream snapshot → durable cursor commit —
+        serialized per stream by the session lock, idempotent under
+        re-dispatch, with the background retrain hooked in. The admission
+        slot taken in submit() is released here whatever happens."""
+        from delphi_tpu.api import Delphi
+        from delphi_tpu.errors import NullErrorDetector
+        from delphi_tpu.incremental.stream import StreamCommitError
+        from delphi_tpu.observability import provenance
+        from delphi_tpu.parallel import resilience
+
+        import pandas as pd
+
+        t0 = time.perf_counter()
+        rid = job.request_id
+        payload = job.payload
+        stream_req = payload["stream"]
+        sid = str(stream_req.get("id"))
+        rows = _stream_rows(payload)
+        sess = None
+        ledger: Optional[Any] = None
+        try:
+            rem = job.remaining_s()
+            if rem is not None and rem <= 0:
+                raise resilience.DeadlineExceeded(
+                    f"request {rid} deadline expired after "
+                    f"{-rem:.3f}s in the admission queue")
+            sess = self.streams.session(sid)
+            row_id = payload["row_id"]
+            delta_df = pd.DataFrame(
+                {c: pd.Series(v) for c, v in payload["table"].items()})
+            chain_fp = chain_fingerprint(payload) or "stream"
+            job.fp = chain_fp
+
+            def _repair_model(name: str, incremental: bool,
+                              snap_dir: Optional[str]) -> Any:
+                model = Delphi.getOrCreate().repair \
+                    .setTableName(name) \
+                    .setRowId(row_id) \
+                    .setErrorDetectors([NullErrorDetector()])
+                model.option("model.checkpoint_path",
+                             self._models_dir(chain_fp))
+                for key, value in (payload.get("options") or {}).items():
+                    model.option(str(key), str(value))
+                if incremental:
+                    model.option("repair.incremental", "true")
+                    model.option("repair.snapshot.dir", snap_dir)
+                return model
+
+            def _registered(name: str, frame: Any) -> str:
+                from delphi_tpu.session import get_session
+                from delphi_tpu.table import check_input_table
+                encoded, _cont = check_input_table(frame, row_id, name)
+                get_session().register(name, encoded)
+                return name
+
+            def run_fn(accumulated: Any, snap_dir: str, seq: int
+                       ) -> Tuple[Any, Optional[Dict[str, Any]]]:
+                from delphi_tpu.session import get_session
+                name = _registered(f"stream_{sid[:16]}_{seq}", accumulated)
+                try:
+                    os.makedirs(snap_dir, exist_ok=True)
+                    model = _repair_model(name, True, snap_dir)
+                    out = model.run()
+                    # canonical ordering, same as the batch path: any
+                    # replica (or a solo batch run) answers byte-identically
+                    out = out.sort_values(
+                        list(out.columns)).reset_index(drop=True)
+                    return out, getattr(model, "_last_incremental", None)
+                finally:
+                    get_session().drop(name)
+
+            def retrain_fn(accumulated: Any) -> Dict[str, Any]:
+                from delphi_tpu.session import get_session
+                name = _registered(f"stream_{sid[:16]}_retrain",
+                                   accumulated)
+                try:
+                    model = _repair_model(name, False, None)
+                    model.run()
+                    return dict(getattr(model, "_last_models", None) or [])
+                finally:
+                    get_session().drop(name)
+
+            # the delta splice stamps per-cell reused/recomputed decisions
+            # into the chain's provenance: a per-request ledger (file under
+            # DELPHI_SERVE_PROVENANCE_DIR, else in-memory) keeps those
+            # stamps isolated from every other session in this process —
+            # the process-global ledger would already hold other requests'
+            # cells and silently swallow the splice
+            prov_dir = os.environ.get("DELPHI_SERVE_PROVENANCE_DIR")
+            if prov_dir:
+                os.makedirs(prov_dir, exist_ok=True)
+                ledger = provenance.ProvenanceLedger(
+                    os.path.join(prov_dir, f"{rid}.jsonl"))
+            else:
+                ledger = provenance.ProvenanceLedger(provenance.MEMORY_PATH)
+            scope = resilience.RequestScope(
+                rid, fault_plan=str(payload.get("fault_plan") or ""),
+                deadline_s=rem, checkpoint_dir=self._ckpt_dir(chain_fp))
+            job.scope = scope
+            with resilience.request_scope(scope), \
+                    provenance.scoped_ledger(ledger):
+                status, body = sess.apply(
+                    stream_req.get("seq"),
+                    stream_req.get("parent_snapshot"),
+                    delta_df, run_fn, retrain_fn=retrain_fn)
+            frame = body.pop("frame_df", None)
+            if frame is not None:
+                body["rows"] = int(len(frame))
+                body["frame"] = json.loads(frame.to_json(orient="records"))
+            body["request_id"] = rid
+            job.status_code = status
+            job.response = body
+            counter_inc("serve.completed" if status == 200
+                        else "serve.failed")
+        except resilience.DeadlineExceeded as e:
+            counter_inc("serve.deadline_expired")
+            job.status_code = 504
+            job.response = {"request_id": rid,
+                            "status": "deadline_exceeded", "error": str(e)}
+        except resilience.RunAborted as e:
+            counter_inc("serve.aborted")
+            job.status_code = 503
+            job.response = {
+                "request_id": rid, "status": "aborted", "error": str(e),
+                "resumable": True,
+                "cursor": sess.durable_cursor() if sess else None}
+        except StreamCommitError as e:
+            # NOT acknowledged: the client resends from the echoed cursor
+            counter_inc("serve.failed")
+            job.status_code = 503
+            job.response = {
+                "request_id": rid, "status": "error",
+                "kind": "store_corrupt", "error": str(e),
+                "cursor": sess.durable_cursor() if sess else None}
+        except KeyError as e:
+            job.status_code = 400
+            job.response = {"request_id": rid, "status": "bad_request",
+                            "error": f"missing field {e}"}
+        except BaseException as e:
+            counter_inc("serve.failed")
+            kind = resilience.classify_fault(e)
+            if isinstance(e, resilience.FaultInjected):
+                kind = e.kind
+            job.status_code = 400 if isinstance(e, ValueError) else 500
+            job.response = {
+                "request_id": rid, "status": "error",
+                "kind": kind or type(e).__name__,
+                "error": f"{type(e).__name__}: {e}",
+                "cursor": sess.durable_cursor() if sess else None}
+        finally:
+            if ledger is not None and ledger.path != provenance.MEMORY_PATH:
+                try:
+                    ledger.write()
+                except Exception as e:  # pragma: no cover - best effort
+                    _logger.warning(f"request {rid}: provenance flush "
+                                    f"failed: {e}")
+            self.streams.release(sid, rows)
+            histogram_observe("serve.request_seconds",
+                              time.perf_counter() - t0)
+
 
 class _ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
@@ -829,9 +1081,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
             if path == "/healthz":
                 from delphi_tpu.parallel import store as dstore
                 quarantined = dstore.quarantine_count(srv.cache_dir)
+                recovering = (srv.streams.recovering_count()
+                              if srv.streams is not None else 0)
                 with srv._lock:
+                    # a stream in recovery replay is serving from state
+                    # rebuilt off disk that no commit has confirmed yet —
+                    # degraded until its next delta lands
                     status = "draining" if srv._draining else \
-                        ("degraded" if quarantined else "ok")
+                        ("degraded" if quarantined or recovering
+                         else "ok")
                     body = {
                         "status": status,
                         "in_flight": srv._in_flight,
@@ -839,6 +1097,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
                         "warm_tables": len(srv._tables),
                         "workers": srv.workers,
                         "quarantined": quarantined,
+                        "streams": {
+                            "active": (srv.streams.active_count()
+                                       if srv.streams is not None else 0),
+                            "recovering": recovering,
+                            "lag_rows": (srv.streams.lag_rows()
+                                         if srv.streams is not None
+                                         else 0),
+                        },
                     }
                 self._respond(200, body)
             elif path == "/metrics":
@@ -862,8 +1128,16 @@ class _ServeHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/drain":
+                # cursors FIRST, response SECOND, admission closed LAST:
+                # the drain reply must carry every stream's durable
+                # resume point before a single delta can be refused, so
+                # a mid-stream drain never strands a chain without a
+                # resume point (ordering pinned by a spy test)
+                cursors = srv.stream_cursors()
+                self._respond(200, {"status": "draining",
+                                    "resumable": True,
+                                    "streams": cursors})
                 srv.begin_drain()
-                self._respond(200, {"status": "draining"})
                 return
             if path != "/repair":
                 self._respond(404, {"error": f"unknown path {path}"})
@@ -886,8 +1160,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
             try:
                 job = srv.submit(payload)
             except Rejection as r:
-                self._respond(r.status, {"status": "rejected",
-                                         "error": r.reason},
+                body = {"status": "rejected", "error": r.reason}
+                body.update(r.extra)
+                self._respond(r.status, body,
                               retry_after_s=r.retry_after_s)
                 return
             # rendezvous: the worker's deadline machinery normally answers

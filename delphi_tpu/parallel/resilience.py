@@ -377,6 +377,8 @@ KNOWN_SITES = frozenset({
     "store.report",
     "store.fleet",
     "store.fsck",
+    "store.stream_cursor",
+    "store.stream_state",
 })
 
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")

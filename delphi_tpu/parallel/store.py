@@ -81,6 +81,10 @@ STORE_SITES: Dict[str, str] = {
     "store.provenance": "provenance ledger JSONL dumps",
     "store.report": "run-report JSON files",
     "store.fleet": "fleet worker registration files",
+    "store.stream_cursor": "per-stream durable cursors "
+                           "(incremental/stream.py, one file per commit)",
+    "store.stream_state": "per-stream accumulated tables "
+                          "(incremental/stream.py, one file per commit)",
 }
 
 #: Schema tags paired with the sites above — fsck uses the tag embedded in
@@ -95,6 +99,8 @@ SCHEMA_SITES: Dict[str, str] = {
     "provenance": "store.provenance",
     "run_report": "store.report",
     "fleet_reg": "store.fleet",
+    "stream_cursor": "store.stream_cursor",
+    "stream_state": "store.stream_state",
 }
 
 # roots this process has touched, so health endpoints can report

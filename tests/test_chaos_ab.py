@@ -33,6 +33,19 @@
   reads as not-yet-registered, and a subprocess crash mid-checkpoint
   leaves only reclaimable tmp debris — with every completed frame
   bit-identical to the clean run.
+- ``bench.stream_smoke``: the streaming repair plane A/B — one table
+  streamed as chained deltas against a live RepairServer vs one batch
+  run over the concatenation; the end-state must be bit-identical
+  (frame + provenance splice), duplicates ack idempotently, conflicts
+  409 with the cursor echoed, and ``stream.*`` metrics (including the
+  ``stream.lag_rows`` staleness gauge) are reported.
+- ``bench.stream_chaos_smoke``: the streaming chaos A/B — a 2-worker
+  fleet serves the chain (routed by CHAIN fingerprint to one rendezvous
+  home); a cursor write is torn mid-stream (verified read-back retries
+  and still acks) and the home worker is killed mid-delta (the router
+  re-dispatches, the survivor rebuilds the session from the durable
+  cursor through the shared cache root and commits) — zero acknowledged
+  deltas lost, end-state bit-identical to the batch reference.
 
 All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
@@ -61,7 +74,9 @@ def _clean_chaos_state():
               "DELPHI_FLEET_MAX_HOPS", "DELPHI_FLEET_SPAWN_TIMEOUT_S",
               "DELPHI_METRICS_PATH", "DELPHI_PROVENANCE_PATH",
               "DELPHI_STORE_QUOTA_GB", "DELPHI_STORE_GC_INTERVAL_S",
-              "DELPHI_STORE_GC_LOCK_STALE_S", "DELPHI_SNAPSHOT_CHAIN_KEEP")}
+              "DELPHI_STORE_GC_LOCK_STALE_S", "DELPHI_SNAPSHOT_CHAIN_KEEP",
+              "DELPHI_STREAM_MAX_INFLIGHT", "DELPHI_STREAM_KEEP",
+              "DELPHI_STREAM_DRIFT_MAX")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
@@ -98,3 +113,11 @@ def test_fleet_chaos_failover_bit_identical():
 
 def test_store_chaos_durability_bit_identical():
     assert bench.store_chaos_smoke(bench._smoke_frame()) == 0
+
+
+def test_stream_ab_bit_identical():
+    assert bench.stream_smoke() == 0
+
+
+def test_stream_chaos_failover_resumes_durable_cursor():
+    assert bench.stream_chaos_smoke() == 0

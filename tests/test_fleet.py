@@ -276,6 +276,48 @@ def test_affinity_same_payload_keeps_its_home_worker(router):
             w.close()
 
 
+def test_chain_affinity_routes_stream_deltas_by_chain_root(router):
+    """Chained stream deltas must route by the CHAIN-ROOT fingerprint,
+    not per-delta table content: every link of a chain lands on the home
+    that holds its durable cursor and warm models, counted as
+    ``fleet.affinity.chain_hits``. The delta tables are chosen so their
+    TABLE fingerprints home on the OTHER worker — proof the router keyed
+    on the chain."""
+    from delphi_tpu.observability.serve import chain_fingerprint
+
+    workers = {
+        "0": _ScriptedWorker(lambda p: (200, {"status": "ok"}, {})),
+        "1": _ScriptedWorker(lambda p: (200, {"status": "ok"}, {})),
+    }
+    try:
+        for wid, w in workers.items():
+            _register(router.fleet_dir, wid, w.port)
+        router.start()
+        sid = "chain-test"
+        chain_home = rendezvous_rank(
+            chain_fingerprint({"stream": {"id": sid}}), ["0", "1"])[0]
+        tags = [t for t in (f"a{i}" for i in range(16))
+                if rendezvous_rank(
+                    table_fingerprint(_payload(t)["table"], "tid"),
+                    ["0", "1"])[0] != chain_home][:3]
+        assert tags, "no delta content hashed away from the chain home"
+        for seq, tag in enumerate(tags, start=1):
+            payload = _payload(tag)
+            payload["stream"] = {"id": sid, "seq": seq}
+            status, _, _ = router.handle_repair(payload)
+            assert status == 200
+        assert len(workers[chain_home].requests) == len(tags)
+        other = "1" if chain_home == "0" else "0"
+        assert len(workers[other].requests) == 0
+        snap = _counters(router)
+        assert snap.get("fleet.affinity.chain_hits", 0) == len(tags)
+        assert snap.get("fleet.affinity.hits", 0) == 0
+        assert snap.get("fleet.affinity.misses", 0) == 0
+    finally:
+        for w in workers.values():
+            w.close()
+
+
 # -- membership from liveness files -------------------------------------------
 
 def test_stale_liveness_evicts_and_retouch_rejoins(router):

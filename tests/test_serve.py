@@ -28,6 +28,7 @@ _ENV_VARS = (
     "DELPHI_SERVE_STALL_SHED_S", "DELPHI_SERVE_CACHE_DIR",
     "DELPHI_SERVE_PROVENANCE_DIR", "DELPHI_COMPILE_CACHE_DIR",
     "DELPHI_FLEET_DIR", "DELPHI_FLEET_WORKER_ID", "DELPHI_FLEET_HEARTBEAT_S",
+    "DELPHI_STREAM_MAX_INFLIGHT",
 )
 
 
@@ -366,6 +367,153 @@ def test_drain_completes_in_flight_request():
     finally:
         srv.stop()
         shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def test_drain_reports_stream_cursors_before_closing_admission(tmp_path):
+    """The streaming side of the drain contract: POST /drain must reply
+    with every stream's last durable cursor and ``resumable: true``
+    BEFORE admission closes — the client of a mid-stream drain holds its
+    resume point by the time the first delta can bounce off a 503."""
+    import pandas as pd
+
+    from delphi_tpu.observability import serve as serve_mod
+
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=str(tmp_path / "cache")).start()
+    try:
+        sess = srv.streams.session("s1")
+        st, _ = sess.apply(
+            1, None, pd.DataFrame({"tid": ["1"], "c1": ["v"]}),
+            lambda acc, sd, seq: (acc.copy(), {"snapshot_id": "snap-1"}))
+        assert st == 200
+
+        events = []
+        real_cursors, real_begin = srv.stream_cursors, srv.begin_drain
+        srv.stream_cursors = \
+            lambda: (events.append("cursors"), real_cursors())[1]
+        srv.begin_drain = \
+            lambda: (events.append("begin_drain"), real_begin())[1]
+        real_respond = serve_mod._ServeHandler._respond
+
+        def spy_respond(handler, status, body, **kw):
+            events.append(("respond", status))
+            return real_respond(handler, status, body, **kw)
+
+        serve_mod._ServeHandler._respond = spy_respond
+        try:
+            st, body, _ = _post(srv.port, "/drain", {})
+        finally:
+            serve_mod._ServeHandler._respond = real_respond
+        assert st == 200
+        assert body["status"] == "draining" and body["resumable"] is True
+        assert body["streams"]["s1"]["seq"] == 1
+        assert body["streams"]["s1"]["snapshot_id"] == "snap-1"
+        # cursors read → 200 on the wire → admission closed, exactly once
+        assert events == ["cursors", ("respond", 200), "begin_drain"]
+        with pytest.raises(Rejection) as ei:
+            srv.submit(_payload())
+        assert ei.value.status == 503
+    finally:
+        srv.stop()
+
+
+def test_stream_metrics_preseeded_and_healthz_tracks_recovery(tmp_path):
+    """Every ``stream.*`` counter/gauge is on /metrics at zero before any
+    stream traffic, and /healthz reports ``degraded`` while a stream is
+    in recovery replay (serving off a rebuilt durable cursor no commit
+    has confirmed yet) — then ``ok`` again after the first commit."""
+    import pandas as pd
+
+    from delphi_tpu.incremental.stream import StreamSession
+
+    cache_dir = str(tmp_path / "cache")
+
+    def run(acc, sd, seq):
+        return acc.copy(), {"snapshot_id": f"snap-{seq}"}
+
+    # durable stream state left behind by a previous server's life
+    seed = StreamSession("s1", os.path.join(cache_dir, "streams", "s1"),
+                         store_root=cache_dir)
+    assert seed.apply(1, None, pd.DataFrame({"tid": ["1"], "c1": ["v"]}),
+                      run)[0] == 200
+
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=cache_dir).start()
+    try:
+        _, metrics = _get(srv.port, "/metrics")
+        for name in ("delphi_stream_deltas", "delphi_stream_commits",
+                     "delphi_stream_duplicates", "delphi_stream_conflicts",
+                     "delphi_stream_backpressure_429",
+                     "delphi_stream_commit_retries",
+                     "delphi_stream_recoveries",
+                     "delphi_stream_retrain_triggers",
+                     "delphi_stream_retrain_swaps",
+                     "delphi_stream_retrain_failed",
+                     "delphi_stream_lag_rows", "delphi_stream_active",
+                     "delphi_stream_recovering"):
+            assert _metric(metrics, name) == 0.0
+        _, text = _get(srv.port, "/healthz")
+        assert json.loads(text)["status"] == "ok"
+
+        # first touch rebuilds the session from the durable cursor:
+        # recovery replay until its next commit → degraded
+        sess = srv.streams.session("s1")
+        assert sess.recovering is True
+        _, text = _get(srv.port, "/healthz")
+        health = json.loads(text)
+        assert health["status"] == "degraded"
+        assert health["streams"] == {"active": 1, "recovering": 1,
+                                     "lag_rows": 0}
+
+        # the real delta flow: admit → apply → release (the release is
+        # what refreshes the stream gauges after the commit)
+        srv.streams.admit("s1", 1)
+        try:
+            assert sess.apply(2, "snap-1",
+                              pd.DataFrame({"tid": ["2"], "c1": ["w"]}),
+                              run)[0] == 200
+        finally:
+            srv.streams.release("s1", 1)
+        _, text = _get(srv.port, "/healthz")
+        assert json.loads(text)["status"] == "ok"
+        _, metrics = _get(srv.port, "/metrics")
+        assert _metric(metrics, "delphi_stream_recoveries") == 1.0
+        assert _metric(metrics, "delphi_stream_commits") == 1.0
+        assert _metric(metrics, "delphi_stream_recovering") == 0.0
+    finally:
+        srv.stop()
+
+
+def test_stream_backpressure_429_echoes_cursor_over_http(tmp_path):
+    """A stream past its in-flight bound is refused at admission with
+    429 + Retry-After + the durable cursor in the body: the client knows
+    exactly where the server is and when to come back."""
+    import pandas as pd
+
+    os.environ["DELPHI_STREAM_MAX_INFLIGHT"] = "1"
+    srv = RepairServer(port=0, workers=1, queue_depth=4,
+                       cache_dir=str(tmp_path / "cache")).start()
+    try:
+        sess = srv.streams.session("s1")
+        st, _ = sess.apply(
+            1, None, pd.DataFrame({"tid": ["1"], "c1": ["v"]}),
+            lambda acc, sd, seq: (acc.copy(), {"snapshot_id": "snap-1"}))
+        assert st == 200
+        # occupy the stream's only in-flight slot
+        srv.streams.admit("s1", 4)
+
+        payload = _payload(request_id="busy")
+        payload["stream"] = {"id": "s1", "seq": 2,
+                             "parent_snapshot": "snap-1"}
+        st, body, headers = _post(srv.port, "/repair", payload)
+        assert st == 429
+        assert headers.get("Retry-After") is not None
+        assert body["cursor"]["seq"] == 1
+        _, metrics = _get(srv.port, "/metrics")
+        assert _metric(metrics, "delphi_stream_backpressure_429") >= 1.0
+        assert _metric(metrics, "delphi_stream_lag_rows") == 4.0
+    finally:
+        srv.stop()
 
 
 def _metric(metrics: str, name: str) -> float:

@@ -1,0 +1,390 @@
+"""Streaming repair plane unit tests (delphi_tpu/incremental/stream.py):
+the durable cursor chain (generational commits, validated read-back,
+retention pruning), restart recovery stepping past a corrupt generation,
+idempotent re-apply (duplicates, same-seq conflicts, gaps, parent
+mismatches — every refusal echoing the durable cursor), per-stream
+admission backpressure with the ``stream.lag_rows`` staleness signal,
+torn-write detection through the store-seam fault plan, and the
+drift-gated background retrain (fires exactly once per drift episode,
+never blocks the stream, post-swap repairs bit-identical to a cold
+batch run).
+
+The end-to-end streamed-vs-batch A/B over a live HTTP server (and the
+fleet failover variant) lives in bench.stream_smoke /
+bench.stream_chaos_smoke, exercised by tests/test_chaos_ab.py.
+"""
+
+import os
+import threading
+
+import pandas as pd
+import pytest
+
+import delphi_tpu.observability as obs
+from delphi_tpu.incremental.stream import (
+    StreamBusy, StreamCommitError, StreamManager, StreamSession,
+    load_durable_cursor, validate_stream_id,
+)
+from delphi_tpu.parallel import resilience as rz
+
+_ENV_VARS = (
+    "DELPHI_FAULT_PLAN", "DELPHI_STREAM_MAX_INFLIGHT", "DELPHI_STREAM_KEEP",
+    "DELPHI_STREAM_DRIFT_MAX", "DELPHI_INCREMENTAL", "DELPHI_SNAPSHOT_DIR",
+    "DELPHI_PROVENANCE_PATH", "DELPHI_SNAPSHOT_CHAIN_KEEP",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stream_state():
+    saved = {v: os.environ.get(v) for v in _ENV_VARS}
+    for v in _ENV_VARS:
+        os.environ.pop(v, None)
+    rz.reset_fault_state()
+    yield
+    for v, old in saved.items():
+        if old is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = old
+    rz.reset_fault_state()
+
+
+def _chunk(start: int, count: int, groups, null_every: int = 0
+           ) -> pd.DataFrame:
+    """One delta partition. ``c1`` is a pure function of the group
+    (``v{gid % 7}``) so any model trained on a prefix that covers every
+    group with a clean example learns the same mapping as a full-table
+    model — the property the bit-identity assertions lean on."""
+    groups = list(groups)
+    rows = []
+    for k in range(count):
+        i, gid = start + k, groups[k % len(groups)]
+        null_c1 = bool(null_every) and k % null_every == 0
+        rows.append({"tid": str(i), "c0": f"g{gid}",
+                     "c1": None if null_c1 else f"v{gid % 7}",
+                     "c2": str((i * 7) % 5), "c3": f"w{gid % 5}"})
+    return pd.DataFrame(rows)
+
+
+def _echo_run(accumulated, snap_dir, seq):
+    """Protocol-level stand-in for the repair: the frame is the
+    accumulated table itself, the snapshot id deterministic per seq."""
+    return accumulated.copy(), {"snapshot_id": f"snap-{seq:04d}"}
+
+
+# -- the durable cursor chain -------------------------------------------------
+
+def test_chain_commits_cursor_and_prunes_generations(tmp_path):
+    sess = StreamSession("s1", str(tmp_path / "s1"))
+    assert sess.recovering is False
+    parent = None
+    for seq in (1, 2, 3):
+        st, body = sess.apply(
+            seq, parent, _chunk((seq - 1) * 8, 8, range(8)), _echo_run)
+        assert st == 200 and body["status"] == "ok"
+        assert body["cursor"]["seq"] == seq
+        assert body["cursor"]["rows_total"] == 8 * seq
+        # the drift baselines are server-internal, never on the wire
+        assert "baselines" not in body["cursor"]
+        assert body["stream"]["id"] == "s1"
+        parent = body["cursor"]["snapshot_id"]
+    # default DELPHI_STREAM_KEEP=2: generation 1 pruned, 2 and 3 durable
+    assert sess._generations() == [3, 2]
+    cur = load_durable_cursor(str(tmp_path / "s1"))
+    assert cur["seq"] == 3 and cur["snapshot_id"] == "snap-0003"
+    assert len(sess.table) == 24
+
+
+def test_restart_resumes_at_durable_cursor_and_acks_duplicates(tmp_path):
+    d = str(tmp_path / "s")
+    c1, c2 = _chunk(0, 8, range(8)), _chunk(8, 8, range(8))
+    first = StreamSession("s", d)
+    assert first.apply(1, None, c1, _echo_run)[0] == 200
+    assert first.apply(2, "snap-0001", c2, _echo_run)[0] == 200
+
+    # a new process over the same directory (worker restart, or a fleet
+    # survivor inheriting the chain through the shared cache root)
+    rec = obs.start_recording("test.stream.recover")
+    try:
+        again = StreamSession("s", d)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("stream.recoveries") == 1
+    assert again.recovering is True
+    assert again.cursor["seq"] == 2
+    pd.testing.assert_frame_equal(
+        again.table, pd.concat([c1, c2], ignore_index=True))
+
+    # at-least-once re-send of the head delta acks as a duplicate with
+    # the cursor echoed, and the first post-recovery ack ends recovery
+    st, body = again.apply(2, "snap-0001", c2, _echo_run)
+    assert (st, body["status"]) == (200, "duplicate")
+    assert body["cursor"]["seq"] == 2
+    assert again.recovering is False
+    # so does any older committed seq
+    st, body = again.apply(1, None, c1, _echo_run)
+    assert (st, body["status"]) == (200, "duplicate")
+    # and the chain continues from the rebuilt state
+    st, body = again.apply(3, "snap-0002", _chunk(16, 8, range(8)),
+                           _echo_run)
+    assert st == 200 and body["cursor"]["rows_total"] == 24
+
+
+def test_conflict_gap_and_parent_mismatch_echo_the_cursor(tmp_path):
+    sess = StreamSession("s", str(tmp_path / "s"))
+    # a parent claim against a stream with no durable cursor: the client
+    # is talking to the wrong (or wiped) stream — restart from scratch
+    st, body = sess.apply(1, "snap-9999", _chunk(0, 8, range(8)),
+                          _echo_run)
+    assert (st, body["status"]) == (409, "parent_mismatch")
+    assert body["cursor"] is None
+
+    assert sess.apply(1, None, _chunk(0, 8, range(8)), _echo_run)[0] == 200
+
+    # same seq, different content: at-least-once replay must never
+    # silently overwrite a committed delta
+    st, body = sess.apply(1, None, _chunk(0, 8, range(8), null_every=3),
+                          _echo_run)
+    assert (st, body["status"]) == (409, "conflict")
+    assert body["cursor"]["seq"] == 1
+
+    st, body = sess.apply(3, "snap-0001", _chunk(8, 8, range(8)),
+                          _echo_run)
+    assert (st, body["status"]) == (409, "gap")
+    assert "expected seq 2" in body["error"]
+    assert body["cursor"]["seq"] == 1
+
+    st, body = sess.apply(2, "snap-bogus", _chunk(8, 8, range(8)),
+                          _echo_run)
+    assert (st, body["status"]) == (409, "parent_mismatch")
+    assert body["cursor"]["seq"] == 1
+
+    for bad in (0, -3, "x", None):
+        st, body = sess.apply(bad, None, _chunk(8, 8, range(8)), _echo_run)
+        assert (st, body["status"]) == (400, "bad_request")
+
+
+def test_recovery_steps_past_a_corrupt_generation(tmp_path):
+    os.environ["DELPHI_STREAM_KEEP"] = "4"
+    d = str(tmp_path / "s")
+    sess = StreamSession("s", d)
+    chunks = [_chunk(i * 8, 8, range(8)) for i in range(3)]
+    for seq, c in enumerate(chunks, start=1):
+        assert sess.apply(seq, None, c, _echo_run)[0] == 200
+    # tear the NEWEST cursor generation in place (what a crash mid-write
+    # leaves): recovery must step back to the newest VALID generation
+    cpath = sess._cursor_path(3)
+    with open(cpath, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(cpath) // 2))
+
+    again = StreamSession("s", d)
+    assert again.recovering is True
+    assert again.cursor["seq"] == 2
+    assert len(again.table) == 16
+    # the client resends from the echoed cursor: the re-applied delta 3
+    # commits a fresh valid generation 3 and ends recovery
+    st, body = again.apply(3, "snap-0002", chunks[2], _echo_run)
+    assert st == 200 and body["status"] == "ok"
+    assert again.recovering is False
+    assert load_durable_cursor(d)["seq"] == 3
+
+
+# -- torn commit writes -------------------------------------------------------
+
+def test_torn_cursor_write_detected_before_ack_and_retried(tmp_path):
+    os.environ["DELPHI_FAULT_PLAN"] = "store.stream_cursor:1:torn_write"
+    rz.reset_fault_state()
+    sess = StreamSession("s", str(tmp_path / "s"))
+    rec = obs.start_recording("test.stream.torn")
+    try:
+        st, body = sess.apply(1, None, _chunk(0, 8, range(8)), _echo_run)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    # the read-back converted the believed-success torn write into a
+    # detected failure and the retry committed — the ack is real
+    assert st == 200 and body["status"] == "ok"
+    assert counters.get("stream.commit_retries", 0) >= 1
+    assert load_durable_cursor(str(tmp_path / "s"))["seq"] == 1
+
+
+def test_unverifiable_commit_refuses_the_ack(tmp_path):
+    os.environ["DELPHI_FAULT_PLAN"] = ("store.stream_cursor:1:torn_write,"
+                                       "store.stream_cursor:2:torn_write")
+    rz.reset_fault_state()
+    sess = StreamSession("s", str(tmp_path / "s"))
+    with pytest.raises(StreamCommitError):
+        sess.apply(1, None, _chunk(0, 8, range(8)), _echo_run)
+    # NOT acknowledged: no durable cursor exists for a client to trust
+    assert load_durable_cursor(str(tmp_path / "s")) is None
+    # after the store heals, the client's resend of the SAME seq commits
+    os.environ.pop("DELPHI_FAULT_PLAN")
+    rz.reset_fault_state()
+    st, body = sess.apply(1, None, _chunk(0, 8, range(8)), _echo_run)
+    assert st == 200 and body["status"] == "ok"
+    assert load_durable_cursor(str(tmp_path / "s"))["seq"] == 1
+
+
+# -- admission backpressure ---------------------------------------------------
+
+def test_manager_backpressure_bounds_inflight_and_reports_lag(tmp_path):
+    os.environ["DELPHI_STREAM_MAX_INFLIGHT"] = "1"
+    mgr = StreamManager(str(tmp_path))
+    rec = obs.start_recording("test.stream.backpressure")
+    try:
+        sess = mgr.admit("s", rows=10)
+        assert mgr.lag_rows() == 10
+        with pytest.raises(StreamBusy) as ei:
+            mgr.admit("s", rows=5)
+        snap = rec.registry.snapshot()
+    finally:
+        obs.stop_recording(rec)
+    assert ei.value.stream_id == "s"
+    assert ei.value.cursor is None  # nothing durable yet to point at
+    assert ei.value.retry_after_s > 0
+    assert snap["counters"].get("stream.backpressure_429") == 1
+    # the refusal admitted nothing: lag is still only the in-flight rows
+    assert mgr.lag_rows() == 10
+    assert snap["gauges"].get("stream.lag_rows") == 10
+
+    mgr.release("s", 10)
+    assert mgr.lag_rows() == 0 and sess.pending == 0
+    # the freed slot re-admits the SAME session object
+    assert mgr.admit("s", rows=3) is sess
+
+    # once a cursor is durable, the 429 carries the exact resume point
+    assert sess.apply(1, None, _chunk(0, 8, range(8)), _echo_run)[0] == 200
+    with pytest.raises(StreamBusy) as ei:
+        mgr.admit("s", rows=7)
+    assert ei.value.cursor["seq"] == 1
+
+
+def test_stream_id_validation_rejects_path_escapes():
+    for bad in ("", ".", "..", "../x", "a/b", ".hidden", "x" * 65, "a b"):
+        with pytest.raises(ValueError):
+            validate_stream_id(bad)
+    assert validate_stream_id("chain-1.a_B") == "chain-1.a_B"
+
+
+# -- drift-gated background retrain -------------------------------------------
+
+def _repair_run_fn(tag):
+    """The serve plane's per-delta run_fn, inlined for direct
+    StreamSession tests: incremental repair against the per-stream
+    snapshot, canonical response ordering."""
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.session import get_session
+
+    def run_fn(accumulated, snap_dir, seq):
+        name = f"stream_test_{tag}_{seq}"
+        get_session().register(name, accumulated.copy())
+        try:
+            os.makedirs(snap_dir, exist_ok=True)
+            model = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .option("repair.incremental", "true") \
+                .option("repair.snapshot.dir", snap_dir)
+            out = model.run()
+            out = out.sort_values(list(out.columns)).reset_index(drop=True)
+            return out, getattr(model, "_last_incremental", None)
+        finally:
+            get_session().drop(name)
+
+    return run_fn
+
+
+def _batch_repair(tag, frame):
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.session import get_session
+
+    name = f"stream_test_{tag}"
+    get_session().register(name, frame.copy())
+    try:
+        model = delphi.repair \
+            .setTableName(name) \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()])
+        out = model.run()
+        return model, out.sort_values(
+            list(out.columns)).reset_index(drop=True)
+    finally:
+        get_session().drop(name)
+
+
+def test_drift_gated_retrain_swaps_once_and_never_blocks(tmp_path):
+    """The satellite contract: deltas 1-2 hold the training-time
+    distribution (no trigger); delta 3 introduces eight NEW categories
+    (PSI against the training-time baseline blows past the gate) — the
+    retrain starts off-thread, delta 4 commits while it is still
+    running WITHOUT re-triggering, the swap lands exactly once, and the
+    post-swap delta repairs bit-identical to a cold batch run over the
+    full concatenation."""
+    os.environ["DELPHI_STREAM_DRIFT_MAX"] = "0.6"
+    run_fn = _repair_run_fn("drift")
+    sess = StreamSession("drift", str(tmp_path / "drift"))
+
+    gate = threading.Event()
+    retrain_rows = []
+
+    def retrain_fn(accumulated):
+        # parked on the test gate: proves commits keep flowing while a
+        # retrain is in flight, and pins WHEN the trigger fired
+        retrain_rows.append(len(accumulated))
+        assert gate.wait(timeout=300), "test gate never opened"
+        model, _ = _batch_repair("retrain", accumulated)
+        return dict(getattr(model, "_last_models", None) or [])
+
+    chunks = [
+        _chunk(0, 16, range(8), null_every=5),
+        _chunk(16, 16, range(8), null_every=7),
+        _chunk(32, 16, range(8, 16)),   # the drift: 8 new categories
+        _chunk(48, 16, range(8, 16)),
+        _chunk(64, 16, range(8, 16), null_every=5),
+    ]
+
+    rec = obs.start_recording("test.stream.retrain")
+    parent = None
+    try:
+        for seq in (1, 2):
+            st, body = sess.apply(seq, parent, chunks[seq - 1], run_fn,
+                                  retrain_fn=retrain_fn)
+            assert st == 200
+            parent = body["cursor"]["snapshot_id"]
+        # steady distribution: the training-time gate stayed quiet
+        assert retrain_rows == []
+
+        st, body = sess.apply(3, parent, chunks[2], run_fn,
+                              retrain_fn=retrain_fn)
+        assert st == 200
+        parent = body["cursor"]["snapshot_id"]
+        assert sess._retrain_pending is True
+
+        # the stream never blocks: delta 4 commits while the retrain is
+        # parked, and the pending trigger does not re-fire
+        st, body = sess.apply(4, parent, chunks[3], run_fn,
+                              retrain_fn=retrain_fn)
+        assert st == 200
+        parent = body["cursor"]["snapshot_id"]
+        assert sess._retrain_pending is True
+
+        gate.set()
+        sess.retrain_join(timeout_s=300)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        gate.set()
+        obs.stop_recording(rec)
+
+    assert retrain_rows == [48]  # the seq-3 accumulation, exactly once
+    assert counters.get("stream.retrain.triggers") == 1
+    assert counters.get("stream.retrain.swaps") == 1
+    assert counters.get("stream.retrain.failed", 0) == 0
+
+    # post-swap bit-identity: streaming + background retrain is an
+    # execution strategy, never a different answer
+    st, body = sess.apply(5, parent, chunks[4], run_fn)
+    assert st == 200 and body["status"] == "ok"
+    _, cold = _batch_repair("cold", sess.table)
+    pd.testing.assert_frame_equal(body["frame_df"], cold)
