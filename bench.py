@@ -32,7 +32,20 @@ import sys
 import time
 
 REFERENCE_SECONDS = 247.69667196273804  # flights.py.out, laptop-class CPU
-TESTDATA = "/root/reference/testdata/raha"
+DEFAULT_TESTDATA = "/root/reference/testdata"
+
+
+def resolve_testdata(sub: str = "") -> str:
+    """Root of the benchmark fixture CSVs: ``$DELPHI_TESTDATA``, else the
+    reference checkout, else the seeded gauntlet lookalikes
+    (delphi_tpu/gauntlet/lookalikes.py) materialized on first use — so
+    every entry here runs on a machine with zero external testdata."""
+    root = os.environ.get("DELPHI_TESTDATA", DEFAULT_TESTDATA)
+    if not os.path.isdir(root):
+        from delphi_tpu.gauntlet.lookalikes import materialize_testdata
+        root = materialize_testdata()
+        os.environ["DELPHI_TESTDATA"] = root
+    return os.path.join(root, sub) if sub else root
 
 # TPU init through the axon tunnel is slow when healthy (tens of seconds) and
 # hangs indefinitely when the tunnel is down; bound it hard. Overridable for
@@ -86,7 +99,8 @@ def hospital_scale(scale: int, profile: bool = False) -> None:
 
     device = str(jax.devices()[0])
     _heartbeat(f"hospital-scale prep (scale={scale})")
-    hospital = pd.read_csv("/root/reference/testdata/hospital.csv", dtype=str)
+    hospital = pd.read_csv(
+        os.path.join(resolve_testdata(), "hospital.csv"), dtype=str)
     parts = []
     for i in range(scale):
         part = hospital.copy()
@@ -167,8 +181,9 @@ def flights(scale: int, profile: bool = False) -> None:
 
     device = str(jax.devices()[0])
 
-    flights = pd.read_csv(f"{TESTDATA}/flights.csv", dtype=str)
-    clean = pd.read_csv(f"{TESTDATA}/flights_clean.csv", dtype=str)
+    testdata = resolve_testdata("raha")
+    flights = pd.read_csv(f"{testdata}/flights.csv", dtype=str)
+    clean = pd.read_csv(f"{testdata}/flights_clean.csv", dtype=str)
 
     # ground-truth error cells: flattened cells != clean values (null-safe)
     flat = flights.melt(id_vars=["tuple_id"], var_name="attribute",
@@ -340,6 +355,9 @@ def smoke() -> int:
     if rc:
         return rc
     rc = escalate_smoke()
+    if rc:
+        return rc
+    rc = gauntlet_smoke()
     if rc:
         return rc
     rc = dist_chaos_smoke()
@@ -1299,6 +1317,138 @@ def escalate() -> int:
     _force_cpu_backend()
     return escalate_smoke(n=int(os.environ.get("DELPHI_BENCH_ESC_ROWS",
                                                "96")))
+
+
+def gauntlet_smoke(rows: int = 160) -> int:
+    """Scenario-gauntlet smoke: three small scenarios end-to-end through
+    the real pipeline, asserting
+
+    1. every scenario scores (no scenario error, a cell P/R/F1 block, and
+       a complete dirty/repaired/clean downstream triple),
+    2. repairs actually help (mean cell F1 > 0 and at least one scenario's
+       recall beats the no-repair floor),
+    3. the per-scenario drift gate *evaluates*: a healthy run gated
+       against itself must pass, and a deliberately degraded run (repairs
+       disabled) gated against the healthy baseline must trip.
+
+    Prints one JSON line; exit code 1 on failure."""
+    from delphi_tpu.gauntlet.runner import run_gauntlet
+    from delphi_tpu.observability import drift
+
+    names = ["fd_categorical", "missing_heavy", "correlated_multi"]
+    _heartbeat("gauntlet smoke: healthy run")
+    healthy = run_gauntlet(names=names, rows=rows, seed=0,
+                           heartbeat=_heartbeat)
+    _heartbeat("gauntlet smoke: degraded run (repairs disabled)")
+    degraded = run_gauntlet(names=names, rows=rows, seed=0,
+                            repairs_enabled=False, heartbeat=_heartbeat)
+
+    # the gate compares a current gauntlet section against a baseline RUN
+    # REPORT; wrap the healthy section the way a loaded v7 report carries it
+    baseline = {"gauntlet": healthy}
+    gate_self = drift.evaluate_gauntlet(healthy, baseline, fail_over=0.25)
+    gate_degraded = drift.evaluate_gauntlet(degraded, baseline,
+                                            fail_over=0.25)
+
+    def scored(s):
+        return not s.get("error") \
+            and {"f1", "precision", "recall"} <= set(s["repair"]) \
+            and all(s["downstream"].get(k) is not None
+                    for k in ("dirty", "repaired", "clean"))
+
+    checks = {
+        "all_scored": all(scored(s) for s in healthy["scenarios"].values()),
+        "mean_f1_positive": healthy["mean_f1"] > 0,
+        "some_recall": any(s["repair"]["recall"] > 0.5
+                           for s in healthy["scenarios"].values()),
+        "self_gate_passes": gate_self["failed"] is False
+                            and gate_self["baseline_missing"] is False,
+        "degraded_gate_trips": gate_degraded["failed"] is True,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "gauntlet_smoke", "value": healthy["mean_f1"],
+        "unit": "mean cell F1", "vs_baseline": None, "ok": ok,
+        "rows": rows, "checks": checks,
+        "scenarios": {n: {"f1": s["repair"]["f1"],
+                          "gap_closed": s["downstream"]["gap_closed"]}
+                      for n, s in healthy["scenarios"].items()},
+        "degraded_max_severity": gate_degraded["max_severity"],
+    }), flush=True)
+    if not ok:
+        print(f"gauntlet smoke FAILED: {checks}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def gauntlet() -> int:
+    """`bench.py --gauntlet`: the full scenario registry (5 generated
+    workloads, zero external testdata) through the real pipeline on the
+    CPU backend. Each scenario reports cell-level P/R/F1 against its
+    injected ground truth, the per-attribute scorecard/escalation
+    summaries from the provenance ledger, and the BoostClean-style
+    dirty/repaired/clean downstream triple. DELPHI_GAUNTLET_ROWS/SEED/
+    SCENARIOS size the run; DELPHI_GAUNTLET_BASELINE (a prior run-report
+    JSON) arms the per-scenario drift gate at DELPHI_GAUNTLET_FAIL_OVER
+    (default 0.25) — exit code 1 when it trips or any scenario errors."""
+    _force_cpu_backend()
+    from delphi_tpu import observability as obs
+    from delphi_tpu.gauntlet.runner import (emit_gauntlet_metrics,
+                                            run_gauntlet)
+
+    report = run_gauntlet(heartbeat=_heartbeat)
+
+    drift_result = None
+    rec = obs.start_recording("bench.gauntlet")
+    try:
+        if rec is not None:
+            emit_gauntlet_metrics(rec.registry, report)
+            rec.gauntlet = report
+        baseline_path = os.environ.get("DELPHI_GAUNTLET_BASELINE", "")
+        if baseline_path:
+            from delphi_tpu.observability import drift
+            fail_over = float(os.environ.get(
+                "DELPHI_GAUNTLET_FAIL_OVER", "0.25"))
+            drift_result = drift.evaluate_gauntlet(
+                report, obs.load_run_report(baseline_path),
+                fail_over=fail_over,
+                registry=rec.registry if rec else None)
+    finally:
+        obs.stop_recording(rec)
+
+    errored = sorted(n for n, s in report["scenarios"].items()
+                     if s.get("error"))
+    ok = not errored and not (drift_result or {}).get("failed")
+    print(json.dumps({
+        "metric": "gauntlet", "value": report["mean_f1"],
+        "unit": "mean cell F1", "vs_baseline": None, "ok": ok,
+        "rows": report["rows"], "seed": report["seed"],
+        "mean_gap_closed": report["mean_gap_closed"],
+        "scenarios": {
+            n: {"f1": s["repair"]["f1"],
+                "precision": s["repair"]["precision"],
+                "recall": s["repair"]["recall"],
+                "downstream": s["downstream"],
+                "scorecards": s["scorecard_summary"],
+                "escalation": (s["escalation"] or {}).get("tiers")
+                if s.get("escalation") else None,
+                "elapsed_s": s["elapsed_s"],
+                **({"error": s["error"]} if s.get("error") else {})}
+            for n, s in report["scenarios"].items()},
+        **({"drift": {k: drift_result[k] for k in
+                      ("max_severity", "failed", "baseline_missing")}}
+           if drift_result else {}),
+    }), flush=True)
+    if errored:
+        print(f"gauntlet FAILED: scenarios errored: {errored}",
+              file=sys.stderr)
+        return 1
+    if (drift_result or {}).get("failed"):
+        print("gauntlet FAILED: per-scenario drift gate tripped "
+              f"(max severity {drift_result['max_severity']})",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 # The scoped service-mode plan: one transient upload fault (exercises the
@@ -2677,6 +2827,25 @@ def main() -> None:
                              "via pattern/joint tiers without regressing "
                              "F1, and the adapter tier stays hard off; "
                              "exits 1 on failure")
+    parser.add_argument("--gauntlet", dest="gauntlet", action="store_true",
+                        help="generated scenario gauntlet on the CPU "
+                             "backend: 5 seeded synthetic workloads "
+                             "(planted FDs, numeric regression, heavy "
+                             "missingness, wide fan-out, correlated "
+                             "corruption) with injected errors through the "
+                             "full pipeline, each scored by cell P/R/F1, "
+                             "scorecard/escalation summaries, and the "
+                             "dirty/repaired/clean downstream triple; "
+                             "DELPHI_GAUNTLET_BASELINE arms the per-"
+                             "scenario drift gate; exits 1 on scenario "
+                             "error or gate trip")
+    parser.add_argument("--gauntlet-smoke", dest="gauntlet_smoke",
+                        action="store_true",
+                        help="small 3-scenario gauntlet asserting every "
+                             "scenario scores, the downstream triple is "
+                             "present, a healthy run passes its own gate "
+                             "and a repairs-disabled run trips it; exits "
+                             "1 on failure")
     parser.add_argument("--dist-chaos", dest="dist_chaos",
                         action="store_true",
                         help="distributed resilience A/B on a 2-process "
@@ -2755,6 +2924,13 @@ def main() -> None:
 
     if args.escalate:
         sys.exit(escalate())
+
+    if args.gauntlet:
+        sys.exit(gauntlet())
+
+    if args.gauntlet_smoke:
+        _force_cpu_backend()
+        sys.exit(gauntlet_smoke())
 
     if args.dist_chaos:
         sys.exit(dist_chaos())

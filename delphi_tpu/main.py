@@ -20,6 +20,15 @@ ingests the input as N chained deltas against a durable per-stream cursor
 in D — crash-exact resume, end state bit-identical to one batch run.
 See docs/source/incremental.rst.
 
+Gauntlet mode: `--gauntlet` skips the batch arguments and runs the
+generated scenario gauntlet (`delphi_tpu/gauntlet/`): seeded synthetic
+workloads with injected errors driven through the full pipeline, scored
+per-cell (precision/recall/F1 against the injected ground truth) and by
+downstream model accuracy (dirty vs repaired vs clean). Zero external
+testdata. With `--baseline-report`, per-scenario quality is gated against
+the baseline's `gauntlet` section (exit code 3 on `--drift-fail-over`
+trip). See docs/source/gauntlet.rst.
+
 Service mode: `--serve [--serve-port P] [--serve-cache-dir D]` skips the
 batch arguments entirely and runs the persistent repair service
 (`delphi_tpu/observability/serve.py`): POST /repair, GET /metrics //healthz
@@ -104,6 +113,85 @@ def _stream_batch(args, session) -> int:
     return 0
 
 
+def _run_gauntlet_cli(args, session) -> int:
+    """``--gauntlet``: run the scenario gauntlet and emit the v7 run
+    report's ``gauntlet`` section. Exit 0 on success, 1 when any scenario
+    errored, 3 when the per-scenario drift gate trips vs
+    ``--baseline-report``."""
+    from delphi_tpu import observability as obs
+    from delphi_tpu.gauntlet.runner import emit_gauntlet_metrics, run_gauntlet
+
+    if args.metrics_port is not None:
+        session.conf["repair.metrics.port"] = str(args.metrics_port)
+    names = [n.strip() for n in args.gauntlet_scenarios.split(",")
+             if n.strip()] or None
+    report = run_gauntlet(
+        names=names, rows=args.gauntlet_rows, seed=args.gauntlet_seed,
+        repairs_enabled=not args.gauntlet_no_repairs,
+        heartbeat=lambda msg: print(msg, file=sys.stderr))
+
+    # Each scenario ran under its own recorder (so its scorecards came
+    # from its own provenance ledger); the wrapper recorder opens AFTER
+    # them to carry the aggregate gauntlet.* metrics and the run report.
+    drift_result = None
+    recorder = obs.start_recording(
+        "batch.gauntlet",
+        events_path=obs.events_path_for(args.metrics_out or None))
+    try:
+        if recorder is not None:
+            emit_gauntlet_metrics(recorder.registry, report)
+            recorder.gauntlet = report
+        if args.baseline_report:
+            from delphi_tpu.observability import drift
+            baseline = obs.load_run_report(args.baseline_report)
+            drift_result = drift.evaluate_gauntlet(
+                report, baseline, fail_over=args.drift_fail_over,
+                registry=recorder.registry if recorder else None)
+            if recorder is not None:
+                recorder.drift = drift_result
+    finally:
+        if recorder is not None:
+            obs.stop_recording(recorder)
+            if args.metrics_out:
+                obs.write_run_report(
+                    obs.build_run_report(
+                        recorder,
+                        run={"mode": "gauntlet",
+                             "scenarios": sorted(report["scenarios"])},
+                        status="ok"),
+                    args.metrics_out)
+
+    for name, s in sorted(report["scenarios"].items()):
+        d = s["downstream"]
+        print(f"gauntlet {name}: f1={s['repair']['f1']} "
+              f"({s['repair']['correct']}/{s['repair']['injected']} cells) "
+              f"downstream[{d['metric']}] dirty={d['dirty']} "
+              f"repaired={d['repaired']} clean={d['clean']} "
+              f"gap_closed={d['gap_closed']}"
+              + (f" ERROR={s['error']}" if s.get("error") else ""),
+              file=sys.stderr)
+    print(json.dumps({
+        "mode": "gauntlet", "rows": report["rows"], "seed": report["seed"],
+        "repairs_enabled": report["repairs_enabled"],
+        "mean_f1": report["mean_f1"],
+        "mean_gap_closed": report["mean_gap_closed"],
+        "scenarios": {n: s["repair"]["f1"]
+                      for n, s in report["scenarios"].items()},
+        **({"drift": {k: drift_result[k] for k in
+                      ("max_severity", "failed", "baseline_missing")}}
+           if drift_result else {}),
+    }))
+    if drift_result is not None and drift_result.get("failed"):
+        print(f"gauntlet drift gate FAILED (fail-over "
+              f"{args.drift_fail_over})", file=sys.stderr)
+        return 3
+    errored = [n for n, s in report["scenarios"].items() if s.get("error")]
+    if errored:
+        print(f"gauntlet scenarios errored: {errored}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="delphi_tpu batch repair")
     parser.add_argument("--db", dest="db", type=str, default="",
@@ -154,6 +242,39 @@ def main(argv=None) -> int:
                         action="store_true",
                         help="with --fsck: report health without "
                              "quarantining, deleting, or sweeping")
+    parser.add_argument("--gauntlet", dest="gauntlet", action="store_true",
+                        help="run the generated scenario gauntlet instead of "
+                             "a batch repair: seeded synthetic workloads "
+                             "with injected errors through the full "
+                             "pipeline, scored per-cell (P/R/F1 vs injected "
+                             "ground truth) and by downstream accuracy "
+                             "(dirty vs repaired vs clean). Needs no "
+                             "--input/--output and zero external testdata; "
+                             "with --baseline-report, gates per-scenario "
+                             "quality (exit 3 on --drift-fail-over trip). "
+                             "See docs/source/gauntlet.rst")
+    parser.add_argument("--gauntlet-rows", dest="gauntlet_rows", type=int,
+                        default=None,
+                        help="rows per gauntlet scenario (default 2000; "
+                             "each scenario documents a 2k->100k scale "
+                             "series). Equivalent to DELPHI_GAUNTLET_ROWS")
+    parser.add_argument("--gauntlet-seed", dest="gauntlet_seed", type=int,
+                        default=None,
+                        help="gauntlet generation seed (default 0): the "
+                             "same (scenario, rows, seed) triple is byte-"
+                             "identical everywhere. Equivalent to "
+                             "DELPHI_GAUNTLET_SEED")
+    parser.add_argument("--gauntlet-scenarios", dest="gauntlet_scenarios",
+                        type=str, default="",
+                        help="comma-separated scenario names (default: the "
+                             "full registry). Equivalent to "
+                             "DELPHI_GAUNTLET_SCENARIOS")
+    parser.add_argument("--gauntlet-no-repairs", dest="gauntlet_no_repairs",
+                        action="store_true",
+                        help="deliberate degradation self-test: score the "
+                             "scenarios with repairs disabled, so a "
+                             "--baseline-report gate against a healthy run "
+                             "must trip")
     parser.add_argument("--targets", dest="targets", type=str, default="",
                         help="comma-separated target attributes")
     parser.add_argument("--constraints", dest="constraints", type=str, default="",
@@ -315,6 +436,8 @@ def main(argv=None) -> int:
         return 4 if summary.get("corrupt") else 0
 
     session = get_session()
+    if args.gauntlet:
+        return _run_gauntlet_cli(args, session)
     if args.collective_timeout_s is not None:
         # before distributed init: the join's first membership heartbeat
         # already runs under this deadline

@@ -2206,6 +2206,14 @@ class RepairModel:
                 counter_inc("train.fd_rule_models")
             else:
                 counter_inc("train.stat_models")
+                # task split: continuous targets route to the regression
+                # branch (is_discrete=False); the gauntlet's numeric
+                # scenario pins train.regressors > 0
+                is_discrete = getattr(model, "is_discrete", None)
+                if is_discrete is False:
+                    counter_inc("train.regressors")
+                elif is_discrete is True:
+                    counter_inc("train.classifiers")
 
         #######################################################################
         # 3. Repair Phase

@@ -388,7 +388,12 @@ def _predict_boosted(bins, feats, thrs, leaves, n_rounds, depth, objective, k,
     else:
         F0 = jnp.full((n,), base_score[0])
     if axis_name is not None:
-        F0 = jax.lax.pcast(F0, (axis_name,), to="varying")
+        # newer jax demands an explicit varying cast inside shard_map;
+        # 0.4.x has neither pcast nor pvary and infers it from use
+        if hasattr(jax.lax, "pcast"):
+            F0 = jax.lax.pcast(F0, (axis_name,), to="varying")
+        elif hasattr(jax.lax, "pvary"):
+            F0 = jax.lax.pvary(F0, (axis_name,))
     F, _ = jax.lax.scan(score_tree, F0, (feats, thrs, leaves))
     return F
 

@@ -172,3 +172,106 @@ def evaluate(current_scorecards: Optional[Dict[str, Any]],
             "drift gate FAILED: max divergence {} > fail-over {}".format(
                 result["max_divergence"], fail_over))
     return result
+
+
+# -- gauntlet gate ----------------------------------------------------------
+#
+# The scorecard gate above is distributional: it needs repairs on both
+# sides to say anything (a run that silently stops repairing shows two
+# empty distributions and zero divergence). The gauntlet gate closes that
+# hole with ground truth: every scenario carries its injected-cell F1 and
+# downstream gap-closed, so a quality collapse is a direct, signed drop —
+# not a distribution shift that might wash out.
+
+#: downstream gap-closed lives in [-2, 2]; halve it onto the F1/divergence
+#: scale so one fail-over threshold governs all three signals
+_GAP_SCALE = 0.5
+
+
+def compare_gauntlet(current: Dict[str, Any],
+                     baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-scenario quality drift between two gauntlet report sections.
+
+    For each scenario present on both sides: the (positive = regression)
+    drop in cell F1, the drop in downstream gap-closed, and the scorecard
+    divergence (:func:`compare_scorecards`) between the two runs' per-
+    attribute cards. A scenario's severity is the worst of the three;
+    improvements never contribute."""
+    cur_sc = current.get("scenarios") or {}
+    base_sc = baseline.get("scenarios") or {}
+    per_scenario: Dict[str, Any] = {}
+    for name in sorted(set(cur_sc) | set(base_sc)):
+        c, b = cur_sc.get(name), base_sc.get(name)
+        if c is None or b is None:
+            per_scenario[name] = {
+                "status": "missing_in_current" if c is None
+                else "missing_in_baseline"}
+            continue
+        f1_drop = max(0.0, float(b["repair"]["f1"]) -
+                      float(c["repair"]["f1"]))
+        b_gap = b.get("downstream", {}).get("gap_closed")
+        c_gap = c.get("downstream", {}).get("gap_closed")
+        gap_drop = max(0.0, float(b_gap) - float(c_gap)) \
+            if b_gap is not None and c_gap is not None else 0.0
+        cards = compare_scorecards(c.get("scorecards") or {},
+                                   b.get("scorecards") or {})
+        severity = max(f1_drop, _GAP_SCALE * gap_drop,
+                       cards["max_divergence"])
+        per_scenario[name] = {
+            "f1_drop": round(f1_drop, 6),
+            "gap_closed_drop": round(gap_drop, 6),
+            "scorecard_divergence": cards["max_divergence"],
+            "severity": round(severity, 6),
+        }
+    scored = [v for v in per_scenario.values() if "severity" in v]
+    return {
+        "per_scenario": per_scenario,
+        "max_f1_drop": round(
+            max((v["f1_drop"] for v in scored), default=0.0), 6),
+        "max_gap_closed_drop": round(
+            max((v["gap_closed_drop"] for v in scored), default=0.0), 6),
+        "max_severity": round(
+            max((v["severity"] for v in scored), default=0.0), 6),
+    }
+
+
+def emit_gauntlet_drift_gauges(registry: Any,
+                               drift: Dict[str, Any]) -> None:
+    for name, v in drift.get("per_scenario", {}).items():
+        if "severity" not in v:
+            continue
+        registry.set_gauge(f"drift.gauntlet.{name}.f1_drop", v["f1_drop"])
+        registry.set_gauge(f"drift.gauntlet.{name}.severity", v["severity"])
+    registry.set_gauge("drift.gauntlet.max_severity",
+                       drift.get("max_severity", 0.0))
+    if drift.get("failed") is not None:
+        registry.set_gauge("drift.gauntlet.failed",
+                           1.0 if drift["failed"] else 0.0)
+
+
+def evaluate_gauntlet(current_gauntlet: Optional[Dict[str, Any]],
+                      baseline_report: Optional[Dict[str, Any]],
+                      fail_over: Optional[float] = None,
+                      registry: Any = None) -> Dict[str, Any]:
+    """The per-scenario gauntlet gate: compare against the baseline run
+    report's ``gauntlet`` section, attach the fail verdict, emit gauges.
+
+    A baseline without a gauntlet section (any pre-v7 report) flags
+    ``baseline_missing`` and never fails, mirroring :func:`evaluate`."""
+    baseline_g = (baseline_report or {}).get("gauntlet") or {}
+    result = compare_gauntlet(current_gauntlet or {}, baseline_g)
+    result["baseline_missing"] = not baseline_g.get("scenarios")
+    result["fail_over"] = fail_over
+    result["failed"] = bool(
+        fail_over is not None and not result["baseline_missing"]
+        and result["max_severity"] > fail_over)
+    if registry is not None:
+        try:
+            emit_gauntlet_drift_gauges(registry, result)
+        except Exception as e:
+            _logger.warning(f"failed to emit gauntlet drift gauges: {e}")
+    if result["failed"]:
+        _logger.warning(
+            "gauntlet drift gate FAILED: max severity {} > fail-over {}"
+            .format(result["max_severity"], fail_over))
+    return result

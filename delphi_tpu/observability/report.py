@@ -69,8 +69,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 6
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
+REPORT_SCHEMA_VERSION = 7
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -362,6 +362,7 @@ def build_run_report(recorder: Any,
         "incremental": getattr(recorder, "incremental", None),
         "escalation": getattr(recorder, "escalation", None),
         "dist": getattr(recorder, "dist", None),
+        "gauntlet": getattr(recorder, "gauntlet", None),
     }
 
 
@@ -379,11 +380,12 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1..v5 -> v6 upgrade: each version only adds keys
+    """In-memory v1..v6 -> v7 upgrade: each version only adds keys
     (v2 added ``per_process``, v3 added ``scorecards`` and ``drift``, v4
     added ``incremental``, v5 added ``escalation``, v6 added ``dist`` —
-    the distributed-resilience section), so an older report becomes a
-    valid v6 one by defaulting them. Consumers can rely on the v6 shape
+    the distributed-resilience section, v7 added ``gauntlet`` — the
+    scenario-gauntlet quality section), so an older report becomes a
+    valid v7 one by defaulting them. Consumers can rely on the v7 shape
     regardless of the file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
@@ -395,6 +397,7 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("incremental", None)   # v3 -> v4
     report.setdefault("escalation", None)    # v4 -> v5
     report.setdefault("dist", None)          # v5 -> v6
+    report.setdefault("gauntlet", None)      # v6 -> v7
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

@@ -194,6 +194,9 @@ _SEED_COUNTERS = (
     "stream.conflicts", "stream.backpressure_429", "stream.commit_retries",
     "stream.recoveries", "stream.retrain.triggers", "stream.retrain.swaps",
     "stream.retrain.failed",
+    "gauntlet.scenarios", "gauntlet.scenario_errors",
+    "gauntlet.cells_injected", "gauntlet.repairs",
+    "gauntlet.repairs_correct",
 )
 
 
@@ -377,6 +380,8 @@ class RepairServer:
         gauge_set("stream.lag_rows", 0)
         gauge_set("stream.active", 0)
         gauge_set("stream.recovering", 0)
+        gauge_set("gauntlet.mean_f1", 0)
+        gauge_set("gauntlet.mean_gap_closed", 0)
         from delphi_tpu.incremental.stream import StreamManager
         self.streams = StreamManager(
             os.path.join(self.cache_dir, "streams"),

@@ -40,7 +40,7 @@ offset, writer believes it succeeded) rehearse exactly the kill -9
 failure modes the envelope exists to catch.
 
 Quota GC: ``DELPHI_STORE_QUOTA_GB`` arms a lock-file-guarded LRU sweep
-(validated reads bump mtime, so "recently used" is meaningful) that is
+(validated reads bump atime, so "recently used" is meaningful) that is
 safe against concurrent fleet workers sharing one root; snapshot manifest
 chains are compacted to one base first so delta serving stays O(1) on
 disk. ``main.py --fsck <root>`` runs the same validation standalone.
@@ -340,7 +340,12 @@ def read_bytes(path: str, *, schema: str, site: str,
         return None, "corrupt"
     counter_inc("store.reads")
     try:
-        os.utime(path)  # LRU recency stamp for the quota sweep
+        # LRU recency stamp for the quota sweep. Only atime moves: mtime
+        # must keep meaning "content last written", so checkpoint-reuse
+        # checks (and humans running `ls -l`) can tell a reused artifact
+        # from a rewritten one.
+        st = os.stat(path)
+        os.utime(path, ns=(time.time_ns(), st.st_mtime_ns))
     except OSError:
         pass
     return payload, "ok"
@@ -502,7 +507,8 @@ def gc_sweep(root: str, quota: Optional[int] = None,
     """One quota sweep of a cache root. Under the lock: removes orphaned
     temp files (crash debris), compacts snapshot manifest chains to one
     base, then evicts least-recently-used files (validated reads bump
-    mtime) until the root fits ``quota`` (default: the env quota). Paths
+    atime; writes set mtime) until the root fits ``quota`` (default:
+    the env quota). Paths
     under a ``protect`` prefix — the active fingerprint's warm state —
     are never evicted. Returns a summary dict; ``{"skipped": ...}`` when
     another process holds the lock or no quota applies."""
@@ -548,7 +554,10 @@ def gc_sweep(root: str, quota: Optional[int] = None,
                         except OSError:
                             pass
                     continue
-                entries.append((st.st_mtime, int(st.st_size), path))
+                # recency = later of write (mtime) and validated read
+                # (atime, stamped by read_bytes)
+                entries.append((max(st.st_atime, st.st_mtime),
+                                int(st.st_size), path))
         total = sum(size for _, size, _ in entries)
         evicted_files = 0
         evicted_bytes = 0

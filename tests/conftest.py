@@ -49,11 +49,26 @@ if not (REPO_ROOT / "native" / "build" / "libdelphi_native.so").exists():
         pass
 
 # Reference fixture CSVs; override when the reference checkout lives
-# elsewhere (e.g. CI clones it into the workspace).
+# elsewhere (e.g. CI clones it into the workspace). When the reference
+# tree is absent entirely (this container, most CI hosts), fall back to
+# the seeded gauntlet lookalikes (delphi_tpu/gauntlet/lookalikes.py):
+# same filenames/shapes/pins, so the testdata-dependent suites run
+# everywhere instead of erroring at collection. HAVE_REAL_TESTDATA lets
+# dataset-measured perf gates (test_model_perf) skip under lookalikes.
 TESTDATA = pathlib.Path(
     os.environ.get("DELPHI_TESTDATA", "/root/reference/testdata"))
 BIN_TESTDATA = pathlib.Path(
     os.environ.get("DELPHI_BIN_TESTDATA", "/root/reference/bin/testdata"))
+
+HAVE_REAL_TESTDATA = TESTDATA.is_dir()
+if not HAVE_REAL_TESTDATA:
+    from delphi_tpu.gauntlet.lookalikes import materialize_testdata
+    TESTDATA = pathlib.Path(materialize_testdata())
+    # propagate to subprocess-spawning tests and bench.resolve_testdata()
+    os.environ["DELPHI_TESTDATA"] = str(TESTDATA)
+if not BIN_TESTDATA.is_dir():
+    BIN_TESTDATA = TESTDATA
+    os.environ["DELPHI_BIN_TESTDATA"] = str(BIN_TESTDATA)
 
 
 def load_testdata(name: str, **kwargs) -> pd.DataFrame:
@@ -61,6 +76,12 @@ def load_testdata(name: str, **kwargs) -> pd.DataFrame:
         path = base / name
         if path.exists():
             return pd.read_csv(path, **kwargs)
+    if not HAVE_REAL_TESTDATA:
+        # lookalikes cover the synthesizable fixtures; files that encode
+        # measurements of the real datasets (clean baselines, error-cell
+        # inventories) intentionally don't exist here
+        pytest.skip(f"testdata {name} not available "
+                    "(reference tree absent; no lookalike)")
     raise FileNotFoundError(name)
 
 

@@ -90,9 +90,15 @@ def test_checkpoint_survives_relocation(adult, tmp_path):
 
 
 def test_checkpoint_unreadable_file_ignored(adult, tmp_path):
+    import shutil
+
     (tmp_path / "repair_models.pkl").write_bytes(b"not a pickle")
     df = _repair_model(tmp_path).run()
     assert len(df) > 0
+    # the planted garbage lands in <tmp_path>/quarantine/; drop it so the
+    # process-global health degrade signal (live /healthz scans every root
+    # this process touched) doesn't leak into later tests
+    shutil.rmtree(tmp_path / "quarantine", ignore_errors=True)
 
 
 def test_set_and_get_conf():

@@ -1,0 +1,261 @@
+"""Scenario gauntlet (delphi_tpu/gauntlet/): injector determinism and
+bookkeeping invariants, scenario-registry shape, cell/downstream scoring,
+the per-scenario drift gate, the v6->v7 run-report upgrade, the
+regression-path pin for the numeric scenario, and the tier-1 wrapper
+around ``bench.gauntlet_smoke``."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bench
+from delphi_tpu.gauntlet import (SCENARIOS, NullInjector, OutlierInjector,
+                                 SwapInjector, TypoInjector, generate_scenario,
+                                 inject, scenario_names)
+from delphi_tpu.gauntlet.score import (apply_repairs, downstream_score,
+                                       score_cells, values_match)
+from delphi_tpu.observability import drift
+
+
+@pytest.fixture(autouse=True)
+def _clean_gauntlet_env():
+    saved = {v: os.environ.get(v) for v in
+             ("DELPHI_GAUNTLET_ROWS", "DELPHI_GAUNTLET_SEED",
+              "DELPHI_GAUNTLET_SCENARIOS", "DELPHI_PROVENANCE_PATH",
+              "DELPHI_METRICS_PATH")}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _frame(n=120):
+    rng = np.random.RandomState(7)
+    return pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "cat": [f"c{v}" for v in rng.randint(0, 5, size=n)],
+        "num": np.round(rng.uniform(-3, 3, size=n), 6),
+        "code": [f"{100 + v}-{v % 7}" for v in rng.randint(0, 30, size=n)],
+    })
+
+
+# -- injectors --------------------------------------------------------------
+
+def test_inject_deterministic_byte_identical():
+    clean = _frame()
+    injectors = lambda: [NullInjector(["cat"], rate=0.05),
+                         TypoInjector(["code"], rate=0.05),
+                         OutlierInjector(["num"], rate=0.05),
+                         SwapInjector(["cat"], rate=0.05)]
+    d1, t1 = inject(clean, injectors(), seed=3)
+    d2, t2 = inject(clean, injectors(), seed=3)
+    assert d1.to_csv(index=False) == d2.to_csv(index=False)
+    assert t1 == t2
+
+
+def test_inject_seed_changes_cells():
+    clean = _frame()
+    _, t1 = inject(clean, [NullInjector(["cat", "code"], rate=0.08)], seed=1)
+    _, t2 = inject(clean, [NullInjector(["cat", "code"], rate=0.08)], seed=2)
+    assert set(t1) != set(t2)
+
+
+def test_inject_never_corrupts_a_cell_twice_and_truth_is_exact():
+    """Every differing cell is in the truth map with the clean value, every
+    truth entry actually differs, and no cell carries two corruptions
+    (truth keys are unique by construction, so exact-diff == truth)."""
+    clean = _frame()
+    dirty, truth = inject(clean, [
+        NullInjector(["cat", "code"], rate=0.1),
+        TypoInjector(["cat", "code"], rate=0.1),
+        SwapInjector(["cat"], rate=0.1),
+    ], seed=5)
+    diff = set()
+    for col in ("cat", "num", "code"):
+        for i in range(len(clean)):
+            a, b = clean[col].iloc[i], dirty[col].iloc[i]
+            if (pd.isna(a) != pd.isna(b)) or \
+                    (pd.notna(a) and pd.notna(b) and a != b):
+                diff.add((clean["tid"].iloc[i], col))
+    assert diff == set(truth)
+    for (tid, col), v in truth.items():
+        row = clean.index[clean["tid"] == tid][0]
+        assert clean[col].iloc[row] == v
+
+
+def test_inject_row_order_and_clean_frame_untouched():
+    clean = _frame()
+    before = clean.to_csv(index=False)
+    dirty, _ = inject(clean, [NullInjector(["cat"], rate=0.2)], seed=0)
+    assert clean.to_csv(index=False) == before
+    assert list(dirty["tid"]) == list(clean["tid"])
+
+
+# -- scenarios --------------------------------------------------------------
+
+def test_registry_has_five_scenarios_with_scale_series():
+    names = scenario_names()
+    assert len(names) >= 5
+    assert {"fd_categorical", "numeric_regression", "missing_heavy",
+            "wide", "correlated_multi"} <= set(names)
+    for n in names:
+        s = SCENARIOS[n]
+        assert len(s.scales) >= 3 and min(s.scales) <= 2_000 \
+            and max(s.scales) >= 50_000
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_generates_consistent_triple(name):
+    data = generate_scenario(name, rows=200, seed=1)
+    assert len(data.clean) == 200 and len(data.dirty) == 200
+    assert list(data.clean.columns) == list(data.dirty.columns)
+    assert data.truth, "every scenario must inject at least one cell"
+    cols = set(data.clean.columns)
+    assert data.label in cols and set(data.targets) <= cols
+    # injected cells sit in target or detector-covered columns and carry
+    # the clean value
+    for (tid, col), v in data.truth.items():
+        assert col in cols
+    # regenerating with the same triple is byte-identical
+    again = generate_scenario(name, rows=200, seed=1)
+    assert data.dirty.to_csv(index=False) == again.dirty.to_csv(index=False)
+    assert data.truth == again.truth
+
+
+def test_wide_scenario_is_wide():
+    data = generate_scenario("wide", rows=100, seed=0)
+    assert len(data.clean.columns) - 1 >= 50
+
+
+def test_missing_heavy_rate():
+    data = generate_scenario("missing_heavy", rows=500, seed=0)
+    frac = data.dirty[["tier", "band", "grade"]].isna().to_numpy().mean()
+    assert frac >= 0.2
+
+
+# -- scoring ----------------------------------------------------------------
+
+def test_values_match_numeric_tolerance():
+    assert values_match("3.001", 3.0)
+    assert values_match(10.4, 10.0)          # 4% relative error
+    assert not values_match(20.0, 10.0)
+    assert values_match("x", "x") and not values_match("x", "y")
+    assert not values_match(None, "x")
+
+
+def test_score_cells_perfect_and_empty():
+    truth = {("0", "a"): "v0", ("1", "a"): "v1"}
+    frame = pd.DataFrame({"tid": ["0", "1"], "attribute": ["a", "a"],
+                          "repaired": ["v0", "v1"]})
+    s = score_cells(frame, truth)
+    assert s["f1"] == 1.0 and s["correct"] == 2
+    s0 = score_cells(None, truth)
+    assert s0 == {"injected": 2, "repairs": 0, "correct": 0,
+                  "precision": 0.0, "recall": 0.0, "f1": 0.0}
+
+
+def test_apply_repairs_splices_and_downstream_scores():
+    data = generate_scenario("fd_categorical", rows=300, seed=0)
+    # oracle repairs: write the clean value back into every injected cell
+    frame = pd.DataFrame(
+        [(t, a, v) for (t, a), v in data.truth.items()],
+        columns=["tid", "attribute", "repaired"])
+    repaired = apply_repairs(data.dirty, frame, data.row_id)
+    pd.testing.assert_frame_equal(
+        repaired.fillna("_"), data.clean.fillna("_"), check_dtype=False)
+    d = downstream_score(data, repaired, seed=0)
+    assert d["task"] == "classification" and d["metric"] == "accuracy"
+    assert d["repaired"] == d["clean"]           # oracle == clean variant
+    assert d["train_rows"] + d["test_rows"] == 300
+
+
+# -- drift gate -------------------------------------------------------------
+
+def _mini_gauntlet(f1, gap):
+    return {"scenarios": {"s": {
+        "repair": {"f1": f1, "precision": f1, "recall": f1,
+                   "injected": 10, "repairs": 10, "correct": int(10 * f1)},
+        "downstream": {"gap_closed": gap},
+        "scorecards": None}}}
+
+
+def test_evaluate_gauntlet_trips_on_f1_collapse():
+    healthy = _mini_gauntlet(0.9, 0.8)
+    degraded = _mini_gauntlet(0.0, -0.5)
+    baseline = {"gauntlet": healthy}
+    ok = drift.evaluate_gauntlet(healthy, baseline, fail_over=0.25)
+    assert ok["failed"] is False and ok["max_severity"] == 0.0
+    bad = drift.evaluate_gauntlet(degraded, baseline, fail_over=0.25)
+    assert bad["failed"] is True
+    assert bad["per_scenario"]["s"]["f1_drop"] == 0.9
+
+
+def test_evaluate_gauntlet_baseline_missing_never_fails():
+    res = drift.evaluate_gauntlet(_mini_gauntlet(0.0, 0.0),
+                                  {"scorecards": {}}, fail_over=0.01)
+    assert res["baseline_missing"] is True and res["failed"] is False
+
+
+def test_evaluate_gauntlet_improvement_never_contributes():
+    res = drift.evaluate_gauntlet(
+        _mini_gauntlet(0.9, 0.9), {"gauntlet": _mini_gauntlet(0.1, 0.0)},
+        fail_over=0.01)
+    assert res["failed"] is False and res["max_severity"] == 0.0
+
+
+# -- run-report schema v7 ---------------------------------------------------
+
+def test_run_report_v6_upgrades_to_v7():
+    from delphi_tpu import observability as obs
+    v6 = {"schema_version": 6, "kind": obs.REPORT_KIND, "status": "ok",
+          "run": {}, "env": {}, "metrics": {}, "spans": {},
+          "device_time": None, "per_process": None, "scorecards": None,
+          "drift": None, "incremental": None, "escalation": None,
+          "dist": None}
+    up = obs.upgrade_run_report(v6)
+    assert up["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert up["schema_version_loaded_from"] == 6
+    assert up["gauntlet"] is None
+
+
+# -- pipeline integration ---------------------------------------------------
+
+def test_numeric_scenario_exercises_regression_branch():
+    """The regression-path audit: the numeric scenario's continuous target
+    columns must route to regressor training (train.regressors > 0) and
+    produce numeric repairs the scorer can match under tolerance."""
+    from delphi_tpu.gauntlet.runner import run_scenario
+    data = generate_scenario("numeric_regression", rows=300, seed=0)
+    result = run_scenario(data, seed=0)
+    assert not result.get("error")
+    assert result["counters"].get("train.regressors", 0) > 0
+    assert result["repair"]["repairs"] > 0
+
+
+def test_emit_gauntlet_metrics_registry_shape():
+    from delphi_tpu.gauntlet.runner import emit_gauntlet_metrics
+    from delphi_tpu.observability.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    report = {"scenarios": {"s": {
+        "repair": {"injected": 4, "repairs": 3, "correct": 2, "f1": 0.57},
+        "downstream": {"gap_closed": 0.5}}},
+        "mean_f1": 0.57, "mean_gap_closed": 0.5}
+    emit_gauntlet_metrics(reg, report)
+    snap = reg.snapshot()
+    assert snap["counters"]["gauntlet.scenarios"] == 1
+    assert snap["counters"]["gauntlet.cells_injected"] == 4
+    assert snap["counters"]["gauntlet.repairs_correct"] == 2
+    assert snap["gauges"]["gauntlet.mean_f1"] == 0.57
+    assert snap["gauges"]["gauntlet.s.f1"] == 0.57
+    assert snap["gauges"]["gauntlet.s.gap_closed"] == 0.5
+
+
+def test_gauntlet_smoke_wrapper():
+    """Tier-1 wrapper mirroring test_chaos_ab: the 3-scenario gauntlet
+    smoke (healthy scoring + self-gate pass + degraded-run gate trip)
+    must succeed end-to-end."""
+    assert bench.gauntlet_smoke(rows=120) == 0

@@ -160,7 +160,11 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
                      "delphi_escalation_routed",
                      "delphi_escalation_escalated",
                      "delphi_escalation_joint_launches",
-                     "delphi_escalation_adapter_calls"):
+                     "delphi_escalation_adapter_calls",
+                     "delphi_gauntlet_scenarios",
+                     "delphi_gauntlet_cells_injected",
+                     "delphi_gauntlet_repairs_correct",
+                     "delphi_gauntlet_mean_f1"):
             assert name in metrics, f"{name} not pre-seeded on /metrics"
 
         # deadline expiry -> 504, structured status, worker reclaimed
