@@ -366,6 +366,9 @@ def smoke() -> int:
     rc = fleet_chaos_smoke()
     if rc:
         return rc
+    rc = trace_smoke(df)
+    if rc:
+        return rc
     rc = store_chaos_smoke(df)
     if rc:
         return rc
@@ -690,6 +693,268 @@ def plan() -> int:
     from delphi_tpu.observability import live
     live._install_compile_listener()
     return plan_smoke(_smoke_frame())
+
+
+def trace_smoke(df=None) -> int:
+    """Trace-plane A/B, three phases:
+
+    1. the same tiny repair with tracing off vs ``DELPHI_TRACE_DIR``
+       armed must produce bit-identical frames, and the traced run must
+       export a loadable Chrome trace document (span events present,
+       ``trace.traces``/``trace.spans``/``trace.exports`` counters fired);
+    2. a 2-worker fleet serves ONE request carrying a client-minted
+       ``X-Delphi-Trace`` id and a rank-scoped ``rank_death`` plan that
+       kills the request's rendezvous home mid-flight: the router must
+       evict + re-dispatch, and the SINGLE merged trace for that id
+       (served back over ``GET /trace/<id>``) must span >= 2 processes
+       (router + surviving worker) with dispatch AND redispatch instants,
+       while the response stamps the survivor in ``X-Delphi-Worker`` with
+       hop count >= 2;
+    3. a cold + warm plan-store pair (plan_smoke shape): the warm run
+       replans nothing (``launch.replans == 0``, plan-cache hits), yet
+       the launch-cost ledger persisted beside the plans
+       (``ledger.<fp>.json``) prices at least one executed bucket.
+
+    Prints one JSON line; exit code 1 on failure."""
+    import glob as glob_mod
+    import shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.observability import trace as trace_mod
+    from delphi_tpu.session import get_session
+
+    if df is None:
+        df = _smoke_frame()
+    trace_mod.reset_state()
+    # a plan store left armed by an earlier in-process serve-plane run
+    # would shadow the DELPHI_PLAN_DIR this smoke arms in phase 3
+    from delphi_tpu.parallel import planner as planner_mod
+    planner_mod.set_plan_store(None)
+
+    def one_run(tag: str, env: dict) -> dict:
+        _heartbeat(f"trace smoke {tag} run")
+        os.environ["DELPHI_DEVICE_TABLE"] = "1"
+        os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+        os.environ.update(env)
+        # same table name on every run so the phase-3 warm run lands on
+        # the cold run's persisted plans (table-level plan fingerprint)
+        name = "trace_smoke"
+        get_session().register(name, df.copy())
+        rec = obs.start_recording(f"bench.trace.{tag}")
+        try:
+            out = delphi.repair \
+                .setTableName(name) \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop(name)
+            del os.environ["DELPHI_DEVICE_TABLE"]
+            del os.environ["DELPHI_DOMAIN_DEVICE"]
+            for k in env:
+                os.environ.pop(k, None)
+        counters = rec.registry.snapshot()["counters"]
+        return {
+            "traces": int(counters.get("trace.traces", 0)),
+            "spans": int(counters.get("trace.spans", 0)),
+            "exports": int(counters.get("trace.exports", 0)),
+            "ledger_records": int(
+                counters.get("launch.ledger.records", 0)),
+            "plan_cache_hits": int(
+                counters.get("launch.plan_cache.hits", 0)),
+            "replans": int(counters.get("launch.replans", 0)),
+            "frame": out.sort_values(list(out.columns))
+            .reset_index(drop=True),
+        }
+
+    # -- phase 1: off/on bit-identical + a loadable run trace ----------------
+    run_trace_dir = tempfile.mkdtemp(prefix="delphi_trace_run_")
+    off = one_run("off", {})
+    on = one_run("on", {"DELPHI_TRACE_DIR": run_trace_dir})
+    frames_equal = True
+    try:
+        pd.testing.assert_frame_equal(off["frame"], on["frame"])
+    except AssertionError:
+        frames_equal = False
+    for r in (off, on):
+        del r["frame"]
+    run_ids = trace_mod.list_traces(run_trace_dir)
+    run_doc = trace_mod.load_trace(run_ids[0], root=run_trace_dir) \
+        if run_ids else None
+    run_trace_ok = run_doc is not None and any(
+        e.get("cat") == "span" for e in run_doc["traceEvents"])
+    phase1_ok = frames_equal and run_trace_ok and off["traces"] == 0 \
+        and on["traces"] >= 1 and on["spans"] > 0 and on["exports"] >= 1
+
+    # -- phase 2: one fleet request, one mid-flight kill, ONE trace ----------
+    _heartbeat("trace smoke fleet phase (2 workers, mid-flight kill)")
+    from delphi_tpu.observability.fleet import FleetRouter, rendezvous_rank
+    from delphi_tpu.observability.serve import table_fingerprint
+
+    fleet_trace_dir = tempfile.mkdtemp(prefix="delphi_trace_fleet_")
+    fleet_cache = tempfile.mkdtemp(prefix="delphi_trace_fleet_cache_")
+    os.environ["DELPHI_TRACE_DIR"] = fleet_trace_dir
+    os.environ["DELPHI_DOMAIN_DEVICE"] = "1"
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    prev_cc = os.environ.get("DELPHI_COMPILE_CACHE_DIR")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(fleet_cache,
+                                                          "compile")
+
+    def _as_table(frame):
+        split = json.loads(frame.to_json(orient="split"))
+        return {c: [row[i] for row in split["data"]]
+                for i, c in enumerate(split["columns"])}
+
+    table = _as_table(df)
+    tid = trace_mod.new_trace_id()
+    router = FleetRouter(
+        port=0, workers=2, cache_dir=fleet_cache, heartbeat_s=0.5,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": None,
+            "DELPHI_MESH": "off",
+            "DELPHI_FLEET_HEARTBEAT_S": "0.5",
+        })
+    fleet_ok = False
+    fleet_info = {}
+    try:
+        router.start()
+        live = router.refresh_membership()
+        victim = rendezvous_rank(table_fingerprint(table, "tid"), live)[0]
+        survivor = next(w for w in live if w != victim)
+        _heartbeat(f"trace smoke fleet kill (victim worker {victim})")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/repair",
+            data=json.dumps({
+                "table": table, "row_id": "tid", "deadline_s": 600,
+                "request_id": "trace-kill",
+                "fault_plan": f"{victim}:xfer.upload:1:rank_death",
+            }).encode(),
+            headers={"Content-Type": "application/json",
+                     trace_mod.TRACE_HEADER: tid},
+            method="POST")
+        status, resp, resp_headers = None, {}, {}
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                status, resp = r.status, json.loads(r.read())
+                resp_headers = dict(r.headers)
+        except urllib.error.HTTPError as e:
+            status, resp = e.code, json.loads(e.read())
+            resp_headers = dict(e.headers)
+        # the merged trace comes back over the live route, not the files
+        doc = {}
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/trace/{tid}",
+                    timeout=30) as r:
+                doc = json.loads(r.read())
+        except urllib.error.HTTPError:
+            pass
+        events = doc.get("traceEvents") or []
+        names = {e.get("name") for e in events}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+
+        def metric(name):
+            for line in metrics.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[1])
+            return 0.0
+
+        checks = {
+            "request_ok": status == 200,
+            "trace_id_echoed": resp.get("trace_id") == tid
+                and resp_headers.get(trace_mod.TRACE_HEADER) == tid,
+            "survivor_stamped": resp.get("worker_id") == survivor
+                and resp_headers.get("X-Delphi-Worker") == survivor,
+            "multi_hop": int(resp.get("hops") or 0) >= 2
+                and resp_headers.get("X-Delphi-Hops")
+                == str(resp.get("hops")),
+            "one_trace_multi_process":
+                len(doc.get("processes") or []) >= 2,
+            "dispatch_instants": "fleet.dispatch" in names
+                and "fleet.redispatch" in names
+                and "fleet.dispatch_fault" in names,
+            "worker_spans": any(e.get("cat") == "span" for e in events),
+            "router_joined_trace": metric("delphi_trace_joins") >= 1,
+        }
+        fleet_ok = all(checks.values())
+        fleet_info = {
+            "victim": victim, "survivor": survivor, "trace_id": tid,
+            "checks": checks, "trace_events": len(events),
+            "processes": doc.get("processes"),
+        }
+    finally:
+        router.drain()
+        os.environ.pop("DELPHI_TRACE_DIR", None)
+        os.environ.pop("DELPHI_DOMAIN_DEVICE", None)
+        os.environ.pop("DELPHI_RETRY_BASE_S", None)
+        os.environ.pop("DELPHI_COMPILE_CACHE_MIN_S", None)
+        if prev_cc is None:
+            os.environ.pop("DELPHI_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["DELPHI_COMPILE_CACHE_DIR"] = prev_cc
+
+    # -- phase 3: warm plans replan nothing, yet the ledger priced them ------
+    trace_mod.reset_state()
+    plan_dir = tempfile.mkdtemp(prefix="delphi_trace_plans_")
+    cold = one_run("cold", {"DELPHI_PLAN_DIR": plan_dir})
+    jax.clear_caches()
+    warm = one_run("warm", {"DELPHI_PLAN_DIR": plan_dir})
+    for r in (cold, warm):
+        del r["frame"]
+    ledger_report = trace_mod.plan_report(plan_dir)
+    ledger_files = glob_mod.glob(os.path.join(plan_dir, "ledger.*.json"))
+    ledger_ok = cold["ledger_records"] > 0 and len(ledger_files) >= 1 \
+        and ledger_report["ledgers"] >= 1 \
+        and len(ledger_report["buckets"]) > 0 \
+        and sum(b["launches"] for b in ledger_report["buckets"]) > 0 \
+        and warm["plan_cache_hits"] > 0 and warm["replans"] == 0
+
+    ok = phase1_ok and fleet_ok and ledger_ok
+    print(json.dumps({
+        "metric": "trace_smoke", "value": 1 if ok else 0, "unit": "pass",
+        "vs_baseline": None, "ok": ok, "frames_equal": frames_equal,
+        "run_trace_ids": run_ids, "off": off, "on": on,
+        "fleet": fleet_info,
+        "ledger": {"files": len(ledger_files),
+                   "buckets": len(ledger_report["buckets"]),
+                   "cold": cold, "warm": warm},
+    }), flush=True)
+    shutil.rmtree(run_trace_dir, ignore_errors=True)
+    shutil.rmtree(plan_dir, ignore_errors=True)
+    if not ok:
+        print("trace smoke FAILED: one fleet-routed request with a "
+              "mid-flight kill must yield ONE multi-process trace, with "
+              "trace on/off frames bit-identical and the warm plan "
+              "store's launch ledger non-empty "
+              f"(phase1={phase1_ok}, fleet={fleet_info.get('checks')}, "
+              f"ledger={ledger_ok})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def trace() -> int:
+    """Standalone `bench.py --trace-smoke` entry: CPU backend, trace
+    on/off + fleet kill + warm-ledger A/B (see trace_smoke)."""
+    import tempfile
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_DIR",
+                          tempfile.mkdtemp(prefix="delphi_trace_cc_"))
+    os.environ.setdefault("DELPHI_COMPILE_CACHE_MIN_S", "0")
+    _force_cpu_backend()
+    from delphi_tpu.observability import live
+    live._install_compile_listener()
+    return trace_smoke(_smoke_frame())
 
 
 def chaos() -> int:
@@ -1677,11 +1942,11 @@ def fleet_chaos_smoke(df=None) -> int:
             method="POST")
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
-                return r.status, json.loads(r.read())
+                return r.status, json.loads(r.read()), dict(r.headers)
         except urllib.error.HTTPError as e:
-            return e.code, json.loads(e.read())
+            return e.code, json.loads(e.read()), dict(e.headers)
         except Exception as e:  # dropped request — the A/B forbids these
-            return None, {"error": f"{type(e).__name__}: {e}"}
+            return None, {"error": f"{type(e).__name__}: {e}"}, {}
 
     # -- reference: clean single-server run in its own cache root ------------
     _heartbeat("fleet chaos reference (clean single server)")
@@ -1690,8 +1955,8 @@ def fleet_chaos_smoke(df=None) -> int:
                                                           "compile")
     srv = RepairServer(port=0, workers=2, cache_dir=ref_cache).start()
     try:
-        st_ref_a, ref_a = post(srv.port, dict(base_a, request_id="ref-a"))
-        st_ref_b, ref_b = post(srv.port, dict(base_b, request_id="ref-b"))
+        st_ref_a, ref_a, _ = post(srv.port, dict(base_a, request_id="ref-a"))
+        st_ref_b, ref_b, _ = post(srv.port, dict(base_b, request_id="ref-b"))
     finally:
         srv.drain(grace_s=10)
 
@@ -1779,6 +2044,18 @@ def fleet_chaos_smoke(df=None) -> int:
                 == ref_b.get("frame"),
             "victim_process_dead":
                 router._procs[victim].poll() is not None,
+            # every response stamps the worker that actually served it;
+            # the killed request must report the SURVIVOR, at hop >= 2
+            "worker_stamped": all(
+                results.get(t, (0, {}, {}))[1].get("worker_id") is not None
+                and results.get(t, (0, {}, {}))[2].get("X-Delphi-Worker")
+                == str(results.get(t, (0, {}, {}))[1].get("worker_id"))
+                for t in a_tags + ("kill",)),
+            "redispatched_to_survivor":
+                results.get("kill", (0, {}, {}))[1].get("worker_id")
+                not in (None, victim)
+                and int(results.get("kill", (0, {}, {}))[1].get("hops")
+                        or 0) >= 2,
             "evictions_fired": metric("delphi_fleet_evictions") >= 1,
             "redispatches_fired": metric("delphi_fleet_redispatches") >= 1,
             "dispatch_faults_fired":
@@ -2805,6 +3082,17 @@ def main() -> None:
                              "frames, launches <= legacy, pad-waste "
                              "accounting, and warm plan/compile-cache "
                              "reuse; exits 1 on failure")
+    parser.add_argument("--trace-smoke", dest="trace_smoke",
+                        action="store_true",
+                        help="trace-plane A/B on the CPU backend: the smoke "
+                             "frame with tracing off vs DELPHI_TRACE_DIR "
+                             "armed (bit-identical frames, loadable Chrome "
+                             "trace), one fleet-routed request surviving a "
+                             "mid-flight rank_death as ONE multi-process "
+                             "trace with the survivor stamped in "
+                             "X-Delphi-Worker, and a warm plan-store rerun "
+                             "whose launch-cost ledger is non-empty with "
+                             "zero replans; exits 1 on failure")
     parser.add_argument("--chaos", action="store_true",
                         help="resilience A/B on the CPU backend: repairs the "
                              "smoke frame fault-free and under a "
@@ -2915,6 +3203,9 @@ def main() -> None:
 
     if args.plan_smoke:
         sys.exit(plan())
+
+    if args.trace_smoke:
+        sys.exit(trace())
 
     if args.chaos:
         sys.exit(chaos())

@@ -165,7 +165,8 @@ def run_joint_tier(masked: Any, cells: List[RoutedCell],
                 nbr_pot[s, k, :vb, :va] = pot.astype(np.float32)
                 k += 1
 
-        beliefs = joint_beliefs(unary, nbr_idx, nbr_pot, iters)
+        with plan.launch_scope(launch):
+            beliefs = joint_beliefs(unary, nbr_idx, nbr_pot, iters)
         counter_inc("escalation.joint.launches")
         counter_inc("escalation.joint.cells", len(members))
 

@@ -242,6 +242,14 @@ def main(argv=None) -> int:
                         action="store_true",
                         help="with --fsck: report health without "
                              "quarantining, deleting, or sweeping")
+    parser.add_argument("--plan-report", dest="plan_report", type=str,
+                        default="", metavar="ROOT",
+                        help="print the persisted launch-cost ledgers "
+                             "under ROOT (a plans dir, or a serve cache "
+                             "dir containing one) as JSON and exit: "
+                             "per-fingerprint per-phase buckets ranked by "
+                             "pad-adjusted device milliseconds "
+                             "(docs/source/observability.rst)")
     parser.add_argument("--gauntlet", dest="gauntlet", action="store_true",
                         help="run the generated scenario gauntlet instead of "
                              "a batch repair: seeded synthetic workloads "
@@ -434,6 +442,15 @@ def main(argv=None) -> int:
                               repair=not args.fsck_report_only)
         print(json.dumps(summary, indent=2, sort_keys=True))
         return 4 if summary.get("corrupt") else 0
+
+    if args.plan_report:
+        # pure-filesystem mode, like --fsck: read the ledger.<fp>.json
+        # files a serving (or DELPHI_PLAN_DIR) run persisted and rank the
+        # launch buckets by pad-adjusted device cost
+        from delphi_tpu.observability import trace
+        print(json.dumps(trace.plan_report(args.plan_report), indent=2,
+                         sort_keys=True))
+        return 0
 
     session = get_session()
     if args.gauntlet:

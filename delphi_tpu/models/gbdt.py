@@ -972,14 +972,15 @@ def gbdt_cv_grid_search_multi(preps: List[Optional[dict]],
                 stats_np = np.stack(rows)
             else:
                 parts = []
-                for sd in slab_data:
-                    sd["F"], s = run_guarded(
-                        "gbdt.cv_chunk",
-                        lambda sd=sd: fn(
-                            sd["bins"], sd["y"], sd["w"], sd["val"],
-                            sd["ycmp"], sd["log"], sd["iscale"],
-                            sd["cw"], sd["valid"], sd["F"],
-                            lrs, regs, msgs, mcws))
+                for sd, launch in zip(slab_data, slab_plan.launches):
+                    with slab_plan.launch_scope(launch):
+                        sd["F"], s = run_guarded(
+                            "gbdt.cv_chunk",
+                            lambda sd=sd: fn(
+                                sd["bins"], sd["y"], sd["w"], sd["val"],
+                                sd["ycmp"], sd["log"], sd["iscale"],
+                                sd["cw"], sd["valid"], sd["F"],
+                                lrs, regs, msgs, mcws))
                     parts.append(np.asarray(jax.device_get(s))[:sd["n"]])
                 stats_np = np.concatenate(parts, axis=0)
             rounds_done += chunk

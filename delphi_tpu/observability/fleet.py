@@ -64,6 +64,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from delphi_tpu.observability import trace as _trace
 from delphi_tpu.observability.registry import counter_inc, gauge_set
 from delphi_tpu.observability.serve import (
     _knob_float, _knob_int, chain_fingerprint, table_fingerprint,
@@ -85,6 +86,10 @@ _SEED_COUNTERS = (
     "fleet.affinity.hits", "fleet.affinity.misses",
     "fleet.affinity.chain_hits",
     "fleet.registration_corrupt",
+    "trace.traces", "trace.joins", "trace.spans", "trace.exports",
+    "launch.ledger.records", "launch.ledger.flushes",
+    "launch.ledger.loads", "launch.ledger.consults",
+    "launch.ledger.merge_vetoes",
     "store.corrupt", "store.quarantined",
 )
 
@@ -451,9 +456,17 @@ class FleetRouter:
             if not port:
                 raise OSError(f"worker {wid} has no registered port "
                               "(connection refused)")
+            headers = {"Content-Type": "application/json"}
+            # propagate the trace across the router→worker seam: every
+            # dispatch — including shed-hops and post-eviction
+            # re-dispatches — carries the same trace id, so the request's
+            # whole journey merges into ONE trace document
+            trace_header = _trace.header_value()
+            if trace_header:
+                headers[_trace.TRACE_HEADER] = trace_header
             req = urllib.request.Request(
                 f"http://127.0.0.1:{int(port)}/repair", data=data,
-                headers={"Content-Type": "application/json"}, method="POST")
+                headers=headers, method="POST")
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 body = json.loads(resp.read() or b"{}")
                 return int(resp.status), body, dict(resp.headers)
@@ -520,6 +533,8 @@ class FleetRouter:
             else:
                 counter_inc("fleet.affinity.chain_hits" if chain
                             else "fleet.affinity.hits")
+            _trace.instant("fleet.redispatch" if hops > 1
+                           else "fleet.dispatch", worker=wid, hop=hops)
             try:
                 status, body, headers = self._dispatch_once(
                     wid, data, self.dispatch_timeout_s)
@@ -528,6 +543,8 @@ class FleetRouter:
                 kind = resilience.classify_fault(e.cause) or "transient"
                 self._evict(wid, f"dispatch fault ({kind}): {e.cause}",
                             drop_liveness=True)
+                _trace.instant("fleet.dispatch_fault", worker=wid,
+                               hop=hops, kind=kind)
                 _logger.warning(f"fleet.dispatch fault on worker {wid} "
                                 f"({kind}); re-dispatching")
                 continue
@@ -535,7 +552,14 @@ class FleetRouter:
                 and body.get("status") == "rejected"
             if shedding:
                 shed_retry_afters.append(self._retry_after_s(headers))
+                _trace.instant("fleet.shed_hop", worker=wid, hop=hops)
                 continue
+            if isinstance(body, dict):
+                # replica attribution for clients and the load harness:
+                # which worker answered, after how many dispatches —
+                # lifted into X-Delphi-Worker / X-Delphi-Hops by do_POST
+                body.setdefault("worker_id", wid)
+                body["hops"] = hops
             return status, body, None
         if shed_retry_afters:
             counter_inc("fleet.all_shed")
@@ -562,7 +586,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
         return self.server.fleet_router  # type: ignore[attr-defined]
 
     def _respond(self, status: int, body: Dict[str, Any],
-                 retry_after_s: Optional[float] = None) -> None:
+                 retry_after_s: Optional[float] = None,
+                 headers: Optional[Dict[str, Any]] = None) -> None:
         data = json.dumps(body).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
@@ -570,6 +595,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if retry_after_s is not None:
             self.send_header("Retry-After",
                              str(max(1, int(round(retry_after_s)))))
+        for key, value in (headers or {}).items():
+            self.send_header(key, str(value))
         self.end_headers()
         self.wfile.write(data)
 
@@ -609,6 +636,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 report = build_run_report(rt.recorder, run={},
                                           status="serving", error=None)
                 self._respond(200, report)
+            elif path.startswith("/trace/"):
+                doc = _trace.load_trace(path[len("/trace/"):])
+                if doc is None:
+                    self._respond(404, {
+                        "error": "no such trace under "
+                                 f"{_trace.trace_root() or '<unset>'}"})
+                else:
+                    self._respond(200, doc)
             else:
                 self._respond(404, {"error": f"unknown path {path}"})
         except Exception as e:  # pragma: no cover - defensive
@@ -644,8 +679,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
                     "error": "body must be a JSON object with a 'table' "
                              "object and a 'row_id' string"})
                 return
-            status, body, retry_after_s = rt.handle_repair(payload)
-            self._respond(status, body, retry_after_s=retry_after_s)
+            # the router is where a distributed trace is born (or, when a
+            # client already carries one, joined): the scope covers every
+            # dispatch/shed-hop/re-dispatch instant and the header the
+            # dispatch seam stamps on each worker call
+            tid, parent = _trace.parse_header(
+                self.headers.get(_trace.TRACE_HEADER))
+            with _trace.request_scope(tid, parent) as tctx:
+                status, body, retry_after_s = rt.handle_repair(payload)
+                if tctx is not None and isinstance(body, dict):
+                    body.setdefault("trace_id", tctx.trace_id)
+            extra: Dict[str, Any] = {}
+            if isinstance(body, dict):
+                if body.get("worker_id") is not None:
+                    extra["X-Delphi-Worker"] = body["worker_id"]
+                if body.get("hops") is not None:
+                    extra["X-Delphi-Hops"] = body["hops"]
+                if body.get("trace_id"):
+                    extra[_trace.TRACE_HEADER] = body["trace_id"]
+            self._respond(status, body, retry_after_s=retry_after_s,
+                          headers=extra or None)
         except Exception as e:  # pragma: no cover - defensive
             try:
                 self._respond(500, {"error": f"{type(e).__name__}: {e}"})

@@ -164,7 +164,7 @@ def _prom_label(value: str) -> str:
 def render_prometheus(recorder: Any) -> str:
     """The live registry plus run-level gauges in Prometheus text exposition
     format 0.0.4. Counters and gauges map 1:1; histograms render as
-    summaries (p50/p95 quantiles over the reservoir sample)."""
+    summaries (p50/p90/p95/p99 quantiles over the reservoir sample)."""
     snap = recorder.registry.snapshot()
     lines: List[str] = []
 
@@ -181,7 +181,8 @@ def render_prometheus(recorder: Any) -> str:
     for name, hist in snap["histograms"].items():
         pn = _prom_name(name)
         samples = []
-        for q, key in (("0.5", "p50"), ("0.95", "p95")):
+        for q, key in (("0.5", "p50"), ("0.9", "p90"),
+                       ("0.95", "p95"), ("0.99", "p99")):
             if hist[key] is not None:
                 samples.append(
                     f'{pn}{{quantile="{q}"}} {_prom_value(hist[key])}')
@@ -260,6 +261,18 @@ class _Handler(BaseHTTPRequestHandler):
                     status="running")
                 body = json.dumps(report, indent=2).encode()
                 self._respond(200, "application/json", body)
+            elif path.startswith("/trace/"):
+                from delphi_tpu.observability import trace as _trace
+                trace_id = path[len("/trace/"):]
+                doc = _trace.load_trace(trace_id)
+                if doc is None:
+                    self._respond(404, "application/json", json.dumps(
+                        {"error": f"no trace {trace_id!r} under "
+                                  f"{_trace.trace_root() or '<unset>'}"}
+                    ).encode())
+                else:
+                    self._respond(200, "application/json",
+                                  json.dumps(doc).encode())
             else:
                 self._respond(404, "application/json",
                               b'{"error": "not found"}')
@@ -319,10 +332,14 @@ class _Watchdog(threading.Thread):
                     and rec.transition_count != self._dumped_at_transition:
                 self._dumped_at_transition = rec.transition_count
                 rec.registry.inc("watchdog.stalls")
+                # active trace ids ride along so a wedged request is
+                # joinable to its exported /trace/<id> document
+                from delphi_tpu.observability import trace as _trace
                 rec.emit_event({"event": "stall",
                                 "t_s": round(rec.elapsed_s(), 3),
                                 "idle_s": round(idle_s, 3),
-                                "active": rec.active_spans()})
+                                "active": rec.active_spans(),
+                                "traces": _trace.active_traces()})
                 _dump_thread_stacks(rec, idle_s)
                 # checkpoint-and-abort (parallel/resilience.py): with a
                 # checkpoint dir configured (or DELPHI_STALL_ABORT), a

@@ -75,7 +75,9 @@ def _summarize(count: int, total: float, mn: Optional[float],
         "max": mx,
         "mean": (total / count) if count else None,
         "p50": pct(0.50),
+        "p90": pct(0.90),
         "p95": pct(0.95),
+        "p99": pct(0.99),
     }
 
 

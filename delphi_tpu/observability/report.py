@@ -2,11 +2,11 @@
 ``RepairModel.run()`` (and by ``bench.py``) when ``DELPHI_METRICS_PATH`` /
 ``repair.metrics.path`` is set.
 
-Schema (version 5; version 1-4 reports still load, see
+Schema (version 8; version 1-7 reports still load, see
 :func:`load_run_report`)::
 
     {
-      "schema_version": 5,
+      "schema_version": 8,
       "kind": "delphi_tpu.run_report",
       "created_at": "<ISO-8601 UTC>",
       "status": "ok" | "error" | "running",  # "running" from /report only
@@ -44,6 +44,13 @@ Schema (version 5; version 1-4 reports still load, see
                   "adapter": {allowed, calls, attempts, repairs}},
         "routed_cells": [[row_id, attribute], ...],       # capped
         "escalated_cells": [[row_id, attribute, tier, value], ...]
+      },
+      "trace": null | {dir, sample, [trace_id]},   # v8+: trace plane armed
+      "launch_costs": null | {                     # v8+: launch ledger
+        "fingerprints": {"<fp>": {"<phase>": {"<bucket>": {
+            count, wall_s, device_s, useful_units, padded_units,
+            signature}}}},
+        "buckets": 0, "wall_s": 0.0, "device_s": 0.0
       }
     }
 
@@ -69,8 +76,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 7
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+REPORT_SCHEMA_VERSION = 8
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -363,7 +370,30 @@ def build_run_report(recorder: Any,
         "escalation": getattr(recorder, "escalation", None),
         "dist": getattr(recorder, "dist", None),
         "gauntlet": getattr(recorder, "gauntlet", None),
+        "trace": _trace_section(recorder),
+        "launch_costs": _launch_costs_section(recorder),
     }
+
+
+def _trace_section(recorder: Any) -> Optional[Dict[str, Any]]:
+    """v8 ``trace`` section: the distributed-trace identity of this run
+    (stamped by ``trace.finalize_run`` at stop_recording; recomputed here
+    for callers that build a report mid-run, e.g. GET /report)."""
+    info = getattr(recorder, "trace_info", None)
+    if info is not None:
+        return info
+    from delphi_tpu.observability import trace as _trace
+    return _trace.run_trace_info()
+
+
+def _launch_costs_section(recorder: Any) -> Optional[Dict[str, Any]]:
+    """v8 ``launch_costs`` section: per-bucket launch-cost aggregates
+    (wall + xplane-attributed device seconds) from the launch ledger."""
+    costs = getattr(recorder, "launch_costs", None)
+    if costs is not None:
+        return costs
+    from delphi_tpu.observability import trace as _trace
+    return _trace.ledger_summary()
 
 
 def write_run_report(report: Dict[str, Any], path: str) -> None:
@@ -380,13 +410,15 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1..v6 -> v7 upgrade: each version only adds keys
+    """In-memory v1..v7 -> v8 upgrade: each version only adds keys
     (v2 added ``per_process``, v3 added ``scorecards`` and ``drift``, v4
     added ``incremental``, v5 added ``escalation``, v6 added ``dist`` —
     the distributed-resilience section, v7 added ``gauntlet`` — the
-    scenario-gauntlet quality section), so an older report becomes a
-    valid v7 one by defaulting them. Consumers can rely on the v7 shape
-    regardless of the file's age."""
+    scenario-gauntlet quality section, v8 added ``trace`` and
+    ``launch_costs`` — the distributed-trace identity and per-launch
+    device-cost ledger), so an older report becomes a valid v8 one by
+    defaulting them. Consumers can rely on the v8 shape regardless of
+    the file's age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
@@ -398,6 +430,8 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("escalation", None)    # v4 -> v5
     report.setdefault("dist", None)          # v5 -> v6
     report.setdefault("gauntlet", None)      # v6 -> v7
+    report.setdefault("trace", None)         # v7 -> v8
+    report.setdefault("launch_costs", None)  # v7 -> v8
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

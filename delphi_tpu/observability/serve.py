@@ -90,6 +90,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from delphi_tpu.observability import trace as _trace
 from delphi_tpu.observability.registry import (
     counter_inc, gauge_set, histogram_observe,
 )
@@ -186,6 +187,10 @@ _SEED_COUNTERS = (
     "launch.plans", "launch.launches", "launch.buckets", "launch.pieces",
     "launch.padded_units", "launch.useful_units", "launch.merged_buckets",
     "launch.plan_cache.hits", "launch.replans",
+    "launch.ledger.records", "launch.ledger.flushes",
+    "launch.ledger.loads", "launch.ledger.consults",
+    "launch.ledger.merge_vetoes",
+    "trace.traces", "trace.joins", "trace.spans", "trace.exports",
     "store.writes", "store.reads", "store.misses", "store.legacy",
     "store.corrupt", "store.quarantined", "store.torn_writes",
     "store.gc.sweeps", "store.gc.evicted_files", "store.gc.lock_busy",
@@ -495,9 +500,11 @@ class RepairServer:
         models = _count("models")
         ckpts = _count("ckpt")
         try:
+            # ledger.<fp>.json launch-cost ledgers live beside the plans
+            # but are not plans
             plans = len([e for e in os.listdir(
                 os.path.join(self.cache_dir, "plans"))
-                if e.endswith(".json")])
+                if e.endswith(".json") and not e.startswith("ledger.")])
         except OSError:
             plans = 0
         gauge_set("serve.warm_models", models)
@@ -592,6 +599,14 @@ class RepairServer:
             from delphi_tpu import observability as obs
             obs.stop_recording(self._own_recorder)
             self._own_recorder = None
+        # disarm the plan store armed at start() — but only if it is still
+        # OURS: a later-started server (warm restart on another cache dir)
+        # must keep its own store
+        from delphi_tpu.parallel import planner
+        store = planner.get_plan_store()
+        if store is not None and \
+                store.root == os.path.join(self.cache_dir, "plans"):
+            planner.set_plan_store(None)
         _logger.info("repair service stopped")
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -764,6 +779,21 @@ class RepairServer:
         shutil.rmtree(self._models_dir(fp), ignore_errors=True)
 
     def _execute(self, job: RepairJob) -> None:
+        """Trace envelope around one request: continues the caller's
+        trace (the ``X-Delphi-Trace`` header the handler parsed into the
+        payload) or mints a fresh one when ``DELPHI_TRACE_DIR`` is armed,
+        stamps the response with the trace id, and flushes any launch
+        costs this request recorded to the persisted ledger."""
+        parsed = job.payload.get("_trace") or (None, None)
+        with _trace.request_scope(parsed[0], parsed[1]) as tctx:
+            try:
+                self._execute_traced(job)
+            finally:
+                if tctx is not None and isinstance(job.response, dict):
+                    job.response.setdefault("trace_id", tctx.trace_id)
+                _trace.flush_ledger()
+
+    def _execute_traced(self, job: RepairJob) -> None:
         if job.payload.get("stream") is not None:
             self._execute_stream(job)
             return
@@ -956,16 +986,23 @@ class RepairServer:
                 finally:
                     get_session().drop(name)
 
+            # snapshot the request's trace position NOW: the retrain runs
+            # later on its own thread, and adopt() joins its spans under
+            # the request span that triggered it — one coherent trace
+            trace_snap = _trace.capture()
+
             def retrain_fn(accumulated: Any) -> Dict[str, Any]:
                 from delphi_tpu.session import get_session
-                name = _registered(f"stream_{sid[:16]}_retrain",
-                                   accumulated)
-                try:
-                    model = _repair_model(name, False, None)
-                    model.run()
-                    return dict(getattr(model, "_last_models", None) or [])
-                finally:
-                    get_session().drop(name)
+                with _trace.adopt(trace_snap):
+                    name = _registered(f"stream_{sid[:16]}_retrain",
+                                       accumulated)
+                    try:
+                        model = _repair_model(name, False, None)
+                        model.run()
+                        return dict(
+                            getattr(model, "_last_models", None) or [])
+                    finally:
+                        get_session().drop(name)
 
             # the delta splice stamps per-cell reused/recomputed decisions
             # into the chain's provenance: a per-request ledger (file under
@@ -1056,7 +1093,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, body: Dict[str, Any],
                  retry_after_s: Optional[float] = None,
-                 content_type: str = "application/json") -> None:
+                 content_type: str = "application/json",
+                 headers: Optional[Dict[str, Any]] = None) -> None:
         data = json.dumps(body).encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
@@ -1064,6 +1102,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if retry_after_s is not None:
             self.send_header("Retry-After",
                              str(max(1, int(round(retry_after_s)))))
+        for key, value in (headers or {}).items():
+            self.send_header(key, str(value))
         self.end_headers()
         self.wfile.write(data)
 
@@ -1120,6 +1160,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 report = build_run_report(srv.recorder, run={},
                                           status="serving", error=None)
                 self._respond(200, report)
+            elif path.startswith("/trace/"):
+                doc = _trace.load_trace(path[len("/trace/"):])
+                if doc is None:
+                    self._respond(404, {
+                        "error": "no such trace under "
+                                 f"{_trace.trace_root() or '<unset>'}"})
+                else:
+                    self._respond(200, doc)
             else:
                 self._respond(404, {"error": f"unknown path {path}"})
         except Exception as e:  # pragma: no cover - defensive
@@ -1162,6 +1210,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
                     "error": "body must be a JSON object with a 'table' "
                              "object and a 'row_id' string"})
                 return
+            # continue the caller's trace: the router (or a client) hands
+            # us its position via X-Delphi-Trace; the worker thread joins
+            # it in _execute's request scope
+            tid, parent = _trace.parse_header(
+                self.headers.get(_trace.TRACE_HEADER))
+            if tid is not None:
+                payload["_trace"] = (tid, parent)
             try:
                 job = srv.submit(payload)
             except Rejection as r:
@@ -1186,7 +1241,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
                     "status": "deadline_exceeded",
                     "error": "request did not finish within its deadline"})
                 return
-            self._respond(job.status_code, job.response)
+            extra = None
+            if isinstance(job.response, dict) \
+                    and job.response.get("trace_id"):
+                extra = {_trace.TRACE_HEADER: job.response["trace_id"]}
+            self._respond(job.status_code, job.response, headers=extra)
         except Exception as e:  # pragma: no cover - defensive
             try:
                 self._respond(500, {"error": f"{type(e).__name__}: {e}"})

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Any, Dict, IO, List, Optional
 
+from delphi_tpu.observability import trace as _trace
 from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
@@ -24,7 +25,8 @@ _logger = setup_logger()
 
 class Span:
     __slots__ = ("name", "start_s", "wall_s", "children", "failed",
-                 "device_s", "thread", "_t0", "_rec")
+                 "device_s", "thread", "_t0", "_rec",
+                 "span_id", "trace_parent", "trace_t0")
 
     def __init__(self, name: str, start_s: float) -> None:
         self.name = name
@@ -36,6 +38,11 @@ class Span:
         self.thread: Optional[str] = None
         self._t0 = 0.0
         self._rec: Optional["RunRecorder"] = None
+        # Trace identity (observability/trace.py): stamped by
+        # trace.span_started when this thread is inside a trace scope.
+        self.span_id: Optional[str] = None
+        self.trace_parent: Optional[str] = None
+        self.trace_t0 = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -148,6 +155,7 @@ class RunRecorder:
         self._stack().append(span)
         self.current_phase = name
         self._mark_transition()
+        _trace.span_started(span)
         self.emit_event({"event": "span_enter", "name": name,
                          "t_s": round(span.start_s, 6)})
         return span
@@ -155,6 +163,7 @@ class RunRecorder:
     def span_exit(self, span: Span, failed: bool = False) -> None:
         span.wall_s = time.perf_counter() - span._t0
         span.failed = failed
+        _trace.span_finished(span, failed=failed)
         stack = self._stack()
         if span in stack:
             # Pop through any spans left open by exceptions below this one.
@@ -215,6 +224,12 @@ def start_recording(name: str,
         return None
     _current = RunRecorder(name, events_path=events_path)
     try:
+        # run-level trace scope (no-op when DELPHI_TRACE_DIR is unset):
+        # spans on this thread become trace events under a fresh trace_id
+        _current.trace_token = _trace.begin_run_scope()
+    except Exception as e:
+        _logger.warning(f"trace plane failed to start: {e}")
+    try:
         from delphi_tpu.observability import live
         live.maybe_start(_current)
     except Exception as e:
@@ -247,6 +262,15 @@ def stop_recording(recorder: Optional[RunRecorder]) -> None:
     except Exception as e:
         _logger.warning(f"compile-cache stats unavailable: {e}")
     recorder.finish()
+    try:
+        # join xplane device time into the launch ledger, stamp the
+        # report's trace/launch_costs sections, flush the ledger, then
+        # close the run-level trace scope (exports this thread's events)
+        _trace.finalize_run(recorder)
+        _trace.end_run_scope(getattr(recorder, "trace_token", None))
+        recorder.trace_token = None
+    except Exception as e:
+        _logger.warning(f"trace plane failed to finalize: {e}")
     try:
         # Freeze the per-attribute scorecards and flush the ledger file
         # before the multi-host gather below ships them cross-rank.

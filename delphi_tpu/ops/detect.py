@@ -168,7 +168,8 @@ def detect_outliers(table: EncodedTable, continuous_attrs: Sequence[str],
                          dtype=np.float64)
         for span in launch.spans:
             padded[span.key, :span.size] = device_pools[span.key][4]
-        qs = _guarded_percentile_batch(padded)
+        with plan.launch_scope(launch):
+            qs = _guarded_percentile_batch(padded)
         if qs is not None:
             for i, (attr, _, _, _, _) in enumerate(device_pools):
                 fences[attr] = (qs[0, i], qs[1, i])

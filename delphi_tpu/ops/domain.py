@@ -807,8 +807,10 @@ def _bucketed_run(table, jobs, beta=None, phase="domain.scores"):
             g, prep, cidx = ctx[span.key]
             sub = np.asarray(g.rows[span.lo:span.lo + span.size], np.int64)
             batch.append((span.key, span.lo, sub, prep, cidx))
-        _launch_bucket(batch, fused, k, va_pad, vc_pad, launch.padded_size,
-                       codes_state, sentinel, beta, out)
+        with plan.launch_scope(launch):
+            _launch_bucket(batch, fused, k, va_pad, vc_pad,
+                           launch.padded_size, codes_state, sentinel, beta,
+                           out)
     for gi in out:
         out[gi].sort(key=lambda t: t[0])
     return out

@@ -111,11 +111,12 @@ def compute_pairwise_stats(
     plan.record()
     h_xy: Dict[frozenset, float] = {}
     for launch in plan.launches:
-        for span in launch.spans:
-            x, y = uniq[span.key]
-            h_xy[frozenset((x, y))] = _entropy_with_correction(
-                mats[span.key], n_rows,
-                int(domain_stats[x]) * int(domain_stats[y]))
+        with plan.launch_scope(launch):
+            for span in launch.spans:
+                x, y = uniq[span.key]
+                h_xy[frozenset((x, y))] = _entropy_with_correction(
+                    mats[span.key], n_rows,
+                    int(domain_stats[x]) * int(domain_stats[y]))
 
     # H(y) per attr
     h_y: Dict[str, float] = {}

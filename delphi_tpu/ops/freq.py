@@ -276,19 +276,20 @@ def compute_freq_stats(table: EncodedTable,
             batch_cap=per_launch, persist=False)
         pair_plan.record()
         for launch in pair_plan.launches:
-            group = [xla_pairs[span.key] for span in launch.spans]
-            # one [2, P] upload instead of two separate index vectors
-            xy = xfer.to_device(np.asarray(
-                [[name_to_idx[x] for x, _ in group],
-                 [name_to_idx[y] for _, y in group]], dtype=np.int32))
-            flat = np.asarray(run_guarded(
-                "freq.pairs",
-                lambda xy=xy: _batched_pair_counts(codes, xy[0], xy[1],
-                                                   v_pad)))
-            for p, (x, y) in enumerate(group):
-                m = flat[p].reshape(stride, stride)
-                pair_mats[(x, y)] = \
-                    m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
+            with pair_plan.launch_scope(launch):
+                group = [xla_pairs[span.key] for span in launch.spans]
+                # one [2, P] upload instead of two separate index vectors
+                xy = xfer.to_device(np.asarray(
+                    [[name_to_idx[x] for x, _ in group],
+                     [name_to_idx[y] for _, y in group]], dtype=np.int32))
+                flat = np.asarray(run_guarded(
+                    "freq.pairs",
+                    lambda xy=xy: _batched_pair_counts(codes, xy[0], xy[1],
+                                                       v_pad)))
+                for p, (x, y) in enumerate(group):
+                    m = flat[p].reshape(stride, stride)
+                    pair_mats[(x, y)] = \
+                        m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
 
     return FreqStats(
         n_rows=table.n_rows,
@@ -458,25 +459,29 @@ class PairDistinctCounter:
         resident = xfer.device_table_enabled()
         local_counts = [0] * len(todo)
         for launch in plan.launches:
-            chunk = [todo[span.key] for span in launch.spans]
-            padded = chunk + [chunk[-1]] * (launch.batch_pad - len(chunk))
-            if resident:
-                # device-side stacks over the once-uploaded column buffers
-                c1 = jnp.stack([xfer.device_codes(self._table.column(x))
-                                for x, _ in padded])
-                c2 = jnp.stack([xfer.device_codes(self._table.column(y))
-                                for _, y in padded])
-            else:
-                c1 = xfer.to_device(np.stack(
-                    [self._table.column(x).codes for x, _ in padded]))
-                c2 = xfer.to_device(np.stack(
-                    [self._table.column(y).codes for _, y in padded]))
-            from delphi_tpu.parallel.resilience import run_guarded
-            counts = np.asarray(run_guarded(
-                "freq.distinct",
-                lambda c1=c1, c2=c2: _batched_distinct_pair_counts(c1, c2)))
-            for span, c in zip(launch.spans, counts[:len(chunk)]):
-                local_counts[span.key] = int(c)
+            with plan.launch_scope(launch):
+                chunk = [todo[span.key] for span in launch.spans]
+                padded = chunk + [chunk[-1]] * (launch.batch_pad
+                                                - len(chunk))
+                if resident:
+                    # device-side stacks over the once-uploaded column
+                    # buffers
+                    c1 = jnp.stack([xfer.device_codes(self._table.column(x))
+                                    for x, _ in padded])
+                    c2 = jnp.stack([xfer.device_codes(self._table.column(y))
+                                    for _, y in padded])
+                else:
+                    c1 = xfer.to_device(np.stack(
+                        [self._table.column(x).codes for x, _ in padded]))
+                    c2 = xfer.to_device(np.stack(
+                        [self._table.column(y).codes for _, y in padded]))
+                from delphi_tpu.parallel.resilience import run_guarded
+                counts = np.asarray(run_guarded(
+                    "freq.distinct",
+                    lambda c1=c1, c2=c2:
+                        _batched_distinct_pair_counts(c1, c2)))
+                for span, c in zip(launch.spans, counts[:len(chunk)]):
+                    local_counts[span.key] = int(c)
         # the device path only serves non-process-local tables (the branch
         # above), so the per-shard counts ARE the global counts
         for (x, y), c in zip(todo, local_counts):

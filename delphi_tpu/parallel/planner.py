@@ -235,6 +235,15 @@ class LaunchPlan:
             counter_inc("launch.merged_buckets", self.merged_buckets)
         return self
 
+    def launch_scope(self, launch: "Launch"):
+        """Execution scope for ONE launch of this plan: wall/device-time
+        goes to the launch-cost ledger and (when a trace is active) a
+        nested trace event — see ``observability/trace.py``. Call sites
+        wrap the device dispatch: ``with plan.launch_scope(launch): ...``.
+        A no-op context when no run recorder is active."""
+        from delphi_tpu.observability import trace
+        return trace.launch_scope(self, launch)
+
     # -- persistence (pure-data round trip) --------------------------------
 
     def to_payload(self) -> Dict[str, Any]:
@@ -318,16 +327,20 @@ class PlanStore:
         gauge_set("serve.warm_plans", self.n_plans())
 
     def n_plans(self) -> int:
+        # launch-cost ledgers (ledger.<fp>.json, observability/trace.py)
+        # live beside the plans but are not plans
         try:
             return sum(1 for n in os.listdir(self.root)
-                       if n.endswith(".json"))
+                       if n.endswith(".json")
+                       and not n.startswith("ledger."))
         except OSError:
             return 0
 
     def fingerprints(self) -> List[str]:
         try:
             return sorted(n[:-5] for n in os.listdir(self.root)
-                          if n.endswith(".json"))
+                          if n.endswith(".json")
+                          and not n.startswith("ledger."))
         except OSError:
             return []
 
@@ -461,6 +474,15 @@ def plan_launches(
         "enabled": enabled, "tag": policy_tag,
         "cap": batch_cap if isinstance(batch_cap, int) else None,
     }
+    if policy["merge"]:
+        from delphi_tpu.observability import trace as _trace
+        if _trace.plan_cost_enabled():
+            # DELPHI_PLAN_COST=1: merges consult the launch-cost ledger.
+            # The key is only present when the gate is on, so cost-gated
+            # plans never collide with (or shadow) default plans in the
+            # store — and the default signature is byte-identical to the
+            # pre-ledger planner.
+            policy["cost"] = True
     sig = _signature(phase, pieces, policy)
 
     fp = fingerprint if fingerprint is not None else current_fingerprint()
@@ -471,7 +493,8 @@ def plan_launches(
             counter_inc("launch.plan_cache.hits")
             return LaunchPlan.from_payload(phase, stored)
 
-    plan = _compute_plan(phase, pieces, sig, policy, batch_cap)
+    plan = _compute_plan(phase, pieces, sig, policy, batch_cap,
+                         fingerprint=fp)
 
     if store is not None and fp:
         counter_inc("launch.replans")
@@ -482,6 +505,7 @@ def plan_launches(
 def _compute_plan(phase: str, pieces: Sequence[Piece], sig: str,
                   policy: Dict[str, Any],
                   batch_cap: Optional[Union[int, Callable[[Shape, int], int]]],
+                  fingerprint: Optional[str] = None,
                   ) -> LaunchPlan:
     size_floor = policy["floor"]
     chunk = policy["chunk"]
@@ -542,6 +566,14 @@ def _compute_plan(phase: str, pieces: Sequence[Piece], sig: str,
                     t = step_up[t]
                 if t != p:
                     remap[(shape, p)] = t
+        if remap and policy.get("cost"):
+            # DELPHI_PLAN_COST: drop any step-up the persisted ledger has
+            # priced as > MERGE_COST_FACTOR× more expensive per useful
+            # unit than leaving the bucket alone (no data → no veto)
+            from delphi_tpu.observability import trace as _trace
+            remap = {(shape, p): t for (shape, p), t in remap.items()
+                     if _trace.merge_allowed(fingerprint, phase, shape,
+                                             p, t)}
         if remap:
             candidate: Dict[Tuple[Shape, int], List[Span]] = {}
             for (shape, padded), members in buckets.items():

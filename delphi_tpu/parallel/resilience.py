@@ -379,6 +379,7 @@ KNOWN_SITES = frozenset({
     "store.fsck",
     "store.stream_cursor",
     "store.stream_state",
+    "store.trace",
 })
 
 _PLAN_RE = re.compile(r"^\s*([^:\s]+)\s*:\s*(\d+)\s*:\s*([a-z_]+)\s*$")
@@ -791,12 +792,17 @@ def on_watchdog_stall(recorder: Any, idle_s: float) -> None:
     if directory:
         from delphi_tpu.parallel import store as dstore
         try:
+            from delphi_tpu.observability import trace as _trace
             marker = os.path.join(directory, "stall_abort.json")
             dstore.write_json(
                 marker,
                 {"idle_s": round(idle_s, 3),
                  "active_spans": recorder.active_spans(),
-                 "transition_count": recorder.transition_count},
+                 "transition_count": recorder.transition_count,
+                 # the wedged request's trace identity: join key between
+                 # this marker and the exported /trace/<id> document
+                 "trace_ids": _trace.active_trace_ids(),
+                 "traces": _trace.active_traces()},
                 schema="marker", site="store.checkpoint", root=directory)
         except Exception as e:  # marker is best-effort evidence
             _logger.warning(f"failed to write stall marker: {e}")

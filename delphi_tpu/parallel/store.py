@@ -85,6 +85,8 @@ STORE_SITES: Dict[str, str] = {
                            "(incremental/stream.py, one file per commit)",
     "store.stream_state": "per-stream accumulated tables "
                           "(incremental/stream.py, one file per commit)",
+    "store.trace": "distributed-trace part files "
+                   "(observability/trace.py, one file per process)",
 }
 
 #: Schema tags paired with the sites above — fsck uses the tag embedded in
@@ -101,6 +103,8 @@ SCHEMA_SITES: Dict[str, str] = {
     "fleet_reg": "store.fleet",
     "stream_cursor": "store.stream_cursor",
     "stream_state": "store.stream_state",
+    "trace": "store.trace",
+    "launch_ledger": "store.plan",
 }
 
 # roots this process has touched, so health endpoints can report

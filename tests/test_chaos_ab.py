@@ -23,6 +23,15 @@
   completes bit-identical to a clean single-server run (zero drops),
   with ``fleet.evictions``/``fleet.redispatches`` fired and ``/healthz``
   reporting ``degraded``.
+- ``bench.trace_smoke``: the trace-plane A/B — the same repair with
+  tracing off vs ``DELPHI_TRACE_DIR`` armed is bit-identical and exports
+  a loadable Chrome trace; one fleet-routed request carrying a
+  client-minted ``X-Delphi-Trace`` id survives a mid-flight rank_death
+  as ONE multi-process trace (router dispatch + redispatch instants +
+  survivor worker spans), with the survivor stamped in
+  ``X-Delphi-Worker``; and a cold+warm plan-store pair leaves a
+  non-empty launch-cost ledger (``ledger.<fp>.json``) while the warm
+  run replans nothing.
 - ``bench.store_chaos_smoke``: the durable state plane A/B — every
   persistence plane armed (plan store, phase/model checkpoints,
   incremental snapshot, provenance ledger, run report); the first write
@@ -56,6 +65,7 @@ import os
 import pytest
 
 import bench
+from delphi_tpu.observability import trace as tr
 from delphi_tpu.parallel import dist_resilience as dr
 from delphi_tpu.parallel import resilience as rz
 from delphi_tpu.parallel import store as dstore
@@ -76,12 +86,15 @@ def _clean_chaos_state():
               "DELPHI_STORE_QUOTA_GB", "DELPHI_STORE_GC_INTERVAL_S",
               "DELPHI_STORE_GC_LOCK_STALE_S", "DELPHI_SNAPSHOT_CHAIN_KEEP",
               "DELPHI_STREAM_MAX_INFLIGHT", "DELPHI_STREAM_KEEP",
-              "DELPHI_STREAM_DRIFT_MAX")}
+              "DELPHI_STREAM_DRIFT_MAX", "DELPHI_TRACE_DIR",
+              "DELPHI_TRACE_SAMPLE", "DELPHI_PLAN_DIR",
+              "DELPHI_PLAN_COST")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
     dr.reset_dist_state()
     dstore.reset_gc_state()
+    tr.reset_state()
     yield
     for v, old in saved.items():
         if old is None:
@@ -93,6 +106,7 @@ def _clean_chaos_state():
     rz.clear_cpu_fallback()
     dr.reset_dist_state()
     dstore.reset_gc_state()
+    tr.reset_state()
 
 
 def test_chaos_smoke_ab_bit_identical():
@@ -109,6 +123,10 @@ def test_dist_chaos_survivor_bit_identical():
 
 def test_fleet_chaos_failover_bit_identical():
     assert bench.fleet_chaos_smoke() == 0
+
+
+def test_trace_smoke_one_trace_survives_redispatch():
+    assert bench.trace_smoke(bench._smoke_frame()) == 0
 
 
 def test_store_chaos_durability_bit_identical():

@@ -15,6 +15,7 @@ import os
 import shutil
 import tempfile
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -164,7 +165,14 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
                      "delphi_gauntlet_scenarios",
                      "delphi_gauntlet_cells_injected",
                      "delphi_gauntlet_repairs_correct",
-                     "delphi_gauntlet_mean_f1"):
+                     "delphi_gauntlet_mean_f1",
+                     "delphi_trace_traces", "delphi_trace_joins",
+                     "delphi_trace_spans", "delphi_trace_exports",
+                     "delphi_launch_ledger_records",
+                     "delphi_launch_ledger_flushes",
+                     "delphi_launch_ledger_loads",
+                     "delphi_launch_ledger_consults",
+                     "delphi_launch_ledger_merge_vetoes"):
             assert name in metrics, f"{name} not pre-seeded on /metrics"
 
         # deadline expiry -> 504, structured status, worker reclaimed
@@ -412,7 +420,12 @@ def test_drain_reports_stream_cursors_before_closing_admission(tmp_path):
         assert body["status"] == "draining" and body["resumable"] is True
         assert body["streams"]["s1"]["seq"] == 1
         assert body["streams"]["s1"]["snapshot_id"] == "snap-1"
-        # cursors read → 200 on the wire → admission closed, exactly once
+        # cursors read → 200 on the wire → admission closed, exactly once.
+        # begin_drain runs AFTER the response is written, so the client can
+        # return before the handler thread reaches it — wait briefly.
+        deadline = time.monotonic() + 5.0
+        while len(events) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert events == ["cursors", ("respond", 200), "begin_drain"]
         with pytest.raises(Rejection) as ei:
             srv.submit(_payload())

@@ -1,0 +1,458 @@
+"""Trace-plane tests (`delphi_tpu/observability/trace.py`): the
+X-Delphi-Trace header round trip, deterministic id sampling, part-file
+export + multi-process merge, span events carrying (trace_id, span_id,
+parent_span_id), the launch-cost ledger record/flush/merge cycle, the
+DELPHI_PLAN_COST merge veto (both the consult unit and end-to-end
+through the planner, with the off-gate bit-identity guarantee), exact
+p50/p90/p99 quantiles on the Prometheus endpoint, and the stall
+watchdog joining its dump + abort marker to the wedged trace."""
+
+import json
+import os
+import time
+
+import pytest
+
+from delphi_tpu import observability as obs
+from delphi_tpu.observability import live, spans
+from delphi_tpu.observability import trace
+from delphi_tpu.parallel import planner
+from delphi_tpu.parallel import resilience as rz
+from delphi_tpu.parallel import store as dstore
+from delphi_tpu.parallel.planner import Piece
+
+_TRACE_ENV = ("DELPHI_TRACE_DIR", "DELPHI_TRACE_SAMPLE", "DELPHI_PLAN_DIR",
+              "DELPHI_PLAN_COST", "DELPHI_PLAN", "DELPHI_PLAN_MERGE",
+              "DELPHI_STALL_TIMEOUT_S", "DELPHI_STALL_ABORT",
+              "DELPHI_CHECKPOINT_DIR", "DELPHI_RESOURCE_SAMPLER",
+              "DELPHI_METRICS_PORT", "DELPHI_METRICS_PATH")
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_env(monkeypatch):
+    for var in _TRACE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    # a programmatically armed plan store (a serve-plane test that died
+    # mid-teardown) would shadow DELPHI_PLAN_DIR for every test here
+    monkeypatch.setattr(planner, "_store", None)
+    trace.reset_state()
+    rz.clear_abort()
+    yield
+    trace.reset_state()
+    rz.clear_abort()
+    assert obs.current_recorder() is None
+
+
+# -- header propagation ------------------------------------------------------
+
+
+def test_header_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DELPHI_TRACE_DIR", str(tmp_path))
+    with trace.request_scope("req-1234", "parentspan") as ctx:
+        assert ctx is not None
+        assert trace.current_trace_id() == "req-1234"
+        # no local span yet: the remote parent roots outbound dispatches
+        assert trace.current_span_id() == "parentspan"
+        assert trace.header_value() == "req-1234:parentspan"
+        assert trace.parse_header(trace.header_value()) == \
+            ("req-1234", "parentspan")
+    assert trace.current_trace_id() is None
+    assert trace.header_value() is None
+
+
+@pytest.mark.parametrize("raw", [
+    None, "", "   ", "has/slash", "a" * 65, "bad id", "töken",
+    ("a" * 65) + ":parent",
+])
+def test_parse_header_rejects_malformed(raw):
+    assert trace.parse_header(raw) == (None, None)
+
+
+def test_parse_header_drops_only_the_bad_parent():
+    # a malformed parent must not discard the (valid) trace id with it
+    assert trace.parse_header("abc123:bad parent!") == ("abc123", None)
+    assert trace.parse_header("abc123:") == ("abc123", None)
+    assert trace.parse_header("  abc123 ") == ("abc123", None)
+
+
+def test_sampling_is_deterministic_on_the_id(monkeypatch):
+    ids = [trace.new_trace_id() for _ in range(200)]
+    monkeypatch.setenv("DELPHI_TRACE_SAMPLE", "0.5")
+    first = [trace._sampled(t) for t in ids]
+    # same ids, same verdicts — every process keeps/drops the SAME traces
+    assert [trace._sampled(t) for t in ids] == first
+    kept = sum(first)
+    assert 0 < kept < len(ids)
+
+    monkeypatch.setenv("DELPHI_TRACE_SAMPLE", "0")
+    assert not any(trace._sampled(t) for t in ids)
+    monkeypatch.setenv("DELPHI_TRACE_SAMPLE", "1.0")
+    assert all(trace._sampled(t) for t in ids)
+    monkeypatch.setenv("DELPHI_TRACE_SAMPLE", "not-a-rate")
+    assert trace.sample_rate() == 1.0
+
+
+def test_request_scope_disabled_and_sampled_out(tmp_path, monkeypatch):
+    # disabled: no DELPHI_TRACE_DIR -> the scope is a None-yielding no-op
+    with trace.request_scope() as ctx:
+        assert ctx is None
+        assert trace.current_trace_id() is None
+    # sampled out: rate 0 drops even an explicitly joined id
+    monkeypatch.setenv("DELPHI_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("DELPHI_TRACE_SAMPLE", "0")
+    with trace.request_scope("abc123") as ctx:
+        assert ctx is None
+    assert trace.list_traces(str(tmp_path)) == []
+
+
+# -- export + merge ----------------------------------------------------------
+
+
+def test_load_trace_merges_parts_across_processes(tmp_path, monkeypatch):
+    root = str(tmp_path)
+    monkeypatch.setenv("DELPHI_TRACE_DIR", root)
+    tid = trace.new_trace_id()
+    with trace.request_scope(tid):
+        trace.instant("fleet.dispatch", worker=1)
+    # a second process's part file for the same trace (a dispatched
+    # worker): merged by load_trace, ordered by timestamp
+    other_pid = os.getpid() + 1
+    dstore.write_json(
+        os.path.join(root, f"trace.{tid}.{other_pid}.json"),
+        {"trace_id": tid, "pid": other_pid,
+         "traceEvents": [{"name": "w", "ph": "i", "ts": 1.0,
+                          "pid": other_pid, "args": {}}]},
+        schema="trace", site="store.trace", root=root)
+
+    assert trace.list_traces(root) == [tid]
+    doc = trace.load_trace(tid, root=root)
+    assert doc is not None
+    assert doc["trace_id"] == tid
+    assert doc["processes"] == sorted([os.getpid(), other_pid])
+    ts = [e["ts"] for e in doc["traceEvents"]]
+    assert ts == sorted(ts)
+    assert any(e["name"] == "fleet.dispatch" for e in doc["traceEvents"])
+
+    assert trace.load_trace("missing", root=root) is None
+    assert trace.load_trace("../escape", root=root) is None
+
+
+def test_span_events_carry_trace_identity(tmp_path, monkeypatch):
+    recorder = obs.start_recording("trace-spans")
+    monkeypatch.setenv("DELPHI_TRACE_DIR", str(tmp_path))
+    tid = trace.new_trace_id()
+    try:
+        with trace.request_scope(tid, "remoteparent"):
+            outer = spans.span_enter("phase.outer")
+            inner = spans.span_enter("phase.inner")
+            spans.span_exit(inner)
+            spans.span_exit(outer)
+    finally:
+        obs.stop_recording(recorder)
+
+    doc = trace.load_trace(tid, root=str(tmp_path))
+    assert doc is not None
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("cat") == "span"}
+    assert set(by_name) == {"phase.outer", "phase.inner"}
+    out_args = by_name["phase.outer"]["args"]
+    in_args = by_name["phase.inner"]["args"]
+    assert out_args["trace_id"] == in_args["trace_id"] == tid
+    # the nesting is explicit in the parent pointers: inner under outer,
+    # outer under the caller's span from the header
+    assert in_args["parent_span_id"] == out_args["span_id"]
+    assert out_args["parent_span_id"] == "remoteparent"
+    assert by_name["phase.inner"]["ph"] == "X"
+    counters = recorder.registry.snapshot()["counters"]
+    assert counters["trace.spans"] >= 2
+    assert counters["trace.exports"] >= 1
+    assert counters["trace.joins"] >= 1
+
+
+def test_capture_adopt_joins_the_parent_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("DELPHI_TRACE_DIR", str(tmp_path))
+    tid = trace.new_trace_id()
+    with trace.request_scope(tid, "rootspan"):
+        snap = trace.capture()
+    assert snap == {"trace_id": tid, "parent_span_id": "rootspan"}
+    # the retrain thread's scope joins the SAME trace id
+    with trace.adopt(snap) as ctx:
+        assert ctx is not None and ctx.trace_id == tid
+        assert trace.current_span_id() == "rootspan"
+    with trace.adopt(None) as ctx:
+        assert ctx is None
+
+
+# -- launch-cost ledger ------------------------------------------------------
+
+
+def _one_launch_plan(phase="ph.test", sizes=(8,)):
+    return planner.plan_launches(
+        phase, [Piece(key=i, size=s) for i, s in enumerate(sizes)],
+        persist=False)
+
+
+def test_ledger_records_flushes_and_merges(tmp_path, monkeypatch):
+    root = str(tmp_path)
+    monkeypatch.setenv("DELPHI_PLAN_DIR", root)
+    recorder = obs.start_recording("ledger-test")
+    try:
+        plan = _one_launch_plan()
+        launch = plan.launches[0]
+        for _ in range(2):
+            with trace.launch_scope(plan, launch):
+                time.sleep(0.001)
+
+        summary = trace.ledger_summary()
+        assert summary is not None and summary["buckets"] == 1
+        entry = summary["fingerprints"]["local"]["ph.test"][
+            trace.bucket_key(launch)]
+        assert entry["count"] == 2
+        assert entry["useful_units"] == 2 * launch.useful_units
+        assert entry["wall_s"] > 0
+        assert entry["signature"] == plan.signature
+
+        assert trace.flush_ledger() == 1
+        assert trace.ledger_summary() is None  # flushed aggregates clear
+        doc = trace.load_ledger("local", root=root)
+        assert doc["phases"]["ph.test"][trace.bucket_key(launch)][
+            "count"] == 2
+
+        # a later generation merges into the persisted doc, not over it
+        with trace.launch_scope(plan, launch):
+            pass
+        assert trace.flush_ledger() == 1
+        trace.reset_state()  # drop the consult cache, force a re-read
+        doc = trace.load_ledger("local", root=root)
+        assert doc["phases"]["ph.test"][trace.bucket_key(launch)][
+            "count"] == 3
+
+        # ledger files live beside the plans but are NOT plans
+        store = planner.get_plan_store()
+        assert os.path.exists(os.path.join(root, "ledger.local.json"))
+        assert store.n_plans() == 0
+        assert store.fingerprints() == []
+    finally:
+        obs.stop_recording(recorder)
+
+
+def test_launch_scope_without_recorder_records_nothing():
+    plan = _one_launch_plan()
+    with trace.launch_scope(plan, plan.launches[0]):
+        pass
+    assert trace.ledger_summary() is None
+
+
+def test_launch_scope_failed_launch_prices_nothing(monkeypatch):
+    recorder = obs.start_recording("ledger-fail")
+    try:
+        plan = _one_launch_plan()
+        with pytest.raises(RuntimeError):
+            with trace.launch_scope(plan, plan.launches[0]):
+                raise RuntimeError("device OOM")
+        # only executed work prices a bucket
+        assert trace.ledger_summary() is None
+    finally:
+        obs.stop_recording(recorder)
+
+
+def _write_ledger(root, fp, phases):
+    dstore.write_json(
+        os.path.join(root, f"ledger.{fp}.json"),
+        {"fingerprint": fp, "phases": phases},
+        schema="launch_ledger", site="store.plan", root=root)
+
+
+def _entry(wall_s, useful, count=4, device_s=0.0):
+    return {"count": count, "wall_s": wall_s, "device_s": device_s,
+            "useful_units": useful, "padded_units": useful,
+            "signature": "sig"}
+
+
+def test_merge_allowed_vetoes_only_priced_regressions(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(root, exist_ok=True)
+    # from-bucket: 1.0 s per useful unit; to-bucket: 10.0 s per unit —
+    # a > MERGE_COST_FACTOR regression, vetoed
+    _write_ledger(root, "fpveto", {"ph": {
+        "flat:p8b1": _entry(8.0, 8), "flat:p16b1": _entry(160.0, 16)}})
+    assert not trace.merge_allowed("fpveto", "ph", (), 8, 16, root=root)
+
+    # within the factor: allowed (1.0 -> 1.2 per unit, < 1.25x)
+    _write_ledger(root, "fpok", {"ph": {
+        "flat:p8b1": _entry(8.0, 8), "flat:p16b1": _entry(19.2, 16)}})
+    assert trace.merge_allowed("fpok", "ph", (), 8, 16, root=root)
+
+    # no data, no opinion: unknown fingerprint / unpriced to-bucket
+    assert trace.merge_allowed("fpnone", "ph", (), 8, 16, root=root)
+    _write_ledger(root, "fphalf", {"ph": {"flat:p8b1": _entry(8.0, 8)}})
+    assert trace.merge_allowed("fphalf", "ph", (), 8, 16, root=root)
+
+    # device seconds, when attributed, beat wall seconds
+    _write_ledger(root, "fpdev", {"ph": {
+        "flat:p8b1": _entry(999.0, 8, device_s=8.0),
+        "flat:p16b1": _entry(0.0, 16, device_s=160.0)}})
+    assert not trace.merge_allowed("fpdev", "ph", (), 8, 16, root=root)
+
+    # per-chunk phases ("ph[i]") aggregate onto the base phase name
+    _write_ledger(root, "fpchunk", {
+        "ph[0]": {"flat:p8b1": _entry(8.0, 8)},
+        "ph[1]": {"flat:p16b1": _entry(160.0, 16)}})
+    assert not trace.merge_allowed("fpchunk", "ph", (), 8, 16, root=root)
+
+
+def test_plan_cost_gate_off_is_bit_identical(tmp_path, monkeypatch):
+    pieces = [Piece(key=0, size=8), Piece(key=1, size=16)]
+    baseline = planner.plan_launches("ph.gate", pieces, merge=True,
+                                     persist=False)
+    # the bounded same-shape merge folds p8 into p16: one launch
+    assert len(baseline.launches) == 1
+    assert baseline.launches[0].padded_size == 16
+
+    # DELPHI_PLAN_COST=0 (and unset) must not perturb the signature or
+    # the grouping — the acceptance bit-identity guarantee
+    monkeypatch.setenv("DELPHI_PLAN_COST", "0")
+    off = planner.plan_launches("ph.gate", pieces, merge=True,
+                                persist=False)
+    assert off.signature == baseline.signature
+    assert [l.spans for l in off.launches] == \
+        [l.spans for l in baseline.launches]
+
+    # gate on: the signature changes (cost-gated plans never shadow
+    # default plans in the store)
+    monkeypatch.setenv("DELPHI_PLAN_COST", "1")
+    on = planner.plan_launches("ph.gate", pieces, merge=True,
+                               persist=False)
+    assert on.signature != baseline.signature
+
+
+def test_plan_cost_veto_splits_the_merge_end_to_end(tmp_path, monkeypatch):
+    root = str(tmp_path)
+    monkeypatch.setenv("DELPHI_PLAN_DIR", root)
+    monkeypatch.setenv("DELPHI_PLAN_COST", "1")
+    _write_ledger(root, "fpe2e", {"ph.gate": {
+        "flat:p8b1": _entry(8.0, 8), "flat:p16b1": _entry(160.0, 16)}})
+    pieces = [Piece(key=0, size=8), Piece(key=1, size=16)]
+
+    vetoed = planner.plan_launches("ph.gate", pieces, merge=True,
+                                   fingerprint="fpe2e", persist=False)
+    assert sorted(l.padded_size for l in vetoed.launches) == [8, 16]
+    assert vetoed.merged_buckets == 0
+
+    # same gate, no ledger for this fingerprint: the merge proceeds
+    unpriced = planner.plan_launches("ph.gate", pieces, merge=True,
+                                     fingerprint="fpfresh", persist=False)
+    assert len(unpriced.launches) == 1
+
+    recorder = obs.start_recording("veto-counters")
+    try:
+        planner.plan_launches("ph.gate", pieces, merge=True,
+                              fingerprint="fpe2e", persist=False)
+        counters = recorder.registry.snapshot()["counters"]
+        assert counters["launch.ledger.consults"] >= 1
+        assert counters["launch.ledger.merge_vetoes"] >= 1
+    finally:
+        obs.stop_recording(recorder)
+
+
+def test_plan_report_ranks_buckets_by_pad_adjusted_cost(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(root, exist_ok=True)
+    _write_ledger(root, "fpa", {"ph": {
+        "flat:p8b1": _entry(1.0, 8), "flat:p64b1": _entry(100.0, 64)}})
+    report = trace.plan_report(root)
+    assert report["ledgers"] == 1
+    assert [b["bucket"] for b in report["buckets"]] == \
+        ["flat:p64b1", "flat:p8b1"]
+    top = report["buckets"][0]
+    assert top["fingerprint"] == "fpa" and top["phase"] == "ph"
+    assert top["launches"] == 4
+
+
+# -- satellite: exact quantile gauges on /metrics ---------------------------
+
+
+def test_prometheus_percentiles_are_exact_over_the_reservoir():
+    recorder = obs.start_recording("prom-quantiles")
+    try:
+        # 100 observations fit the 512-sample reservoir whole, so the
+        # rendered quantiles are EXACT order statistics, reproducibly
+        for v in range(100, 0, -1):
+            recorder.registry.observe("bench.step_ms", float(v))
+        text = live.render_prometheus(recorder)
+    finally:
+        obs.stop_recording(recorder)
+    lines = text.splitlines()
+    s = sorted(float(v) for v in range(1, 101))
+
+    def rendered(quantile):
+        prefix = f'delphi_bench_step_ms{{quantile="{quantile}"}} '
+        matches = [ln for ln in lines if ln.startswith(prefix)]
+        assert len(matches) == 1, f"missing {prefix!r}"
+        return float(matches[0].split()[-1])
+
+    assert rendered("0.5") == s[int(0.5 * len(s))] == 51.0
+    assert rendered("0.9") == s[int(0.9 * len(s))] == 91.0
+    assert rendered("0.95") == s[int(0.95 * len(s))] == 96.0
+    assert rendered("0.99") == s[int(0.99 * len(s))] == 100.0
+    assert "delphi_bench_step_ms_count 100" in lines
+    assert "delphi_bench_step_ms_sum 5050.0" in lines
+    assert "# TYPE delphi_bench_step_ms summary" in lines
+
+
+# -- satellite: the watchdog joins stalls to traces -------------------------
+
+
+def test_watchdog_stall_dump_names_the_wedged_trace(tmp_path, monkeypatch):
+    trace_dir = tmp_path / "traces"
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("DELPHI_STALL_TIMEOUT_S", "30")
+    monkeypatch.setenv("DELPHI_RESOURCE_SAMPLER", "0")
+    monkeypatch.setenv("DELPHI_TRACE_DIR", str(trace_dir))
+    monkeypatch.setenv("DELPHI_STALL_ABORT", "1")
+    monkeypatch.setenv("DELPHI_CHECKPOINT_DIR", str(ckpt_dir))
+
+    recorder = obs.start_recording("stall-trace", events_path=str(events))
+    assert recorder is not None and recorder.live is not None
+    tid = trace.new_trace_id()
+    try:
+        with trace.request_scope(tid):
+            span = spans.span_enter("wedged phase")
+            try:
+                # fake clock: rewind the transition stamp so the watchdog
+                # sees a long-idle run without the test actually sleeping
+                recorder.last_transition = time.perf_counter() - 999.0
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if recorder.registry.snapshot()["counters"] \
+                            .get("watchdog.stalls", 0) >= 1:
+                        break
+                    time.sleep(0.05)
+                assert recorder.registry.snapshot()["counters"][
+                    "watchdog.stalls"] == 1
+                # the abort request did its job (marker written); clear it
+                # so the teardown path isn't aborted mid-flush
+                rz.clear_abort()
+            finally:
+                spans.span_exit(span)
+    finally:
+        obs.stop_recording(recorder)
+        rz.clear_abort()
+
+    # the stall event stream carries the wedged thread's trace id
+    parsed = [json.loads(ln) for ln in events.read_text().splitlines()]
+    stall_events = [e for e in parsed if e["event"] == "stall"]
+    assert stall_events and tid in stall_events[0]["traces"].values()
+
+    # so does the checkpoint-and-abort marker: the join key between the
+    # stall evidence and the exported /trace/<id> document
+    marker, status = dstore.read_json(
+        str(ckpt_dir / "stall_abort.json"), schema="marker",
+        site="store.checkpoint", root=str(ckpt_dir))
+    assert status == "ok"
+    assert tid in marker["trace_ids"]
+    assert tid in marker["traces"].values()
+    assert any("wedged phase" in stack
+               for stack in marker["active_spans"].values())
