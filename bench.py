@@ -375,7 +375,10 @@ def smoke() -> int:
     rc = stream_smoke()
     if rc:
         return rc
-    return stream_chaos_smoke()
+    rc = stream_chaos_smoke()
+    if rc:
+        return rc
+    return load_smoke()
 
 
 def _smoke_frame():
@@ -2123,6 +2126,346 @@ def fleet_chaos() -> int:
     return fleet_chaos_smoke(_smoke_frame())
 
 
+def _run_load(*, requests, fingerprints, rows, rate_rps, spike_x,
+              zipf_alpha, mix, retry_max, workers, seed,
+              autoscale=None, autoscale_interval_s=0.25,
+              kill_original_worker=True, recovery_fail_over=0.5,
+              scenarios=None, label="load"):
+    """One sustained open-loop load run against a live spawned fleet.
+
+    Starts the bench recorder FIRST so the in-process FleetRouter and
+    FleetAutoscaler share its registry — load.*, fleet.*, autoscale.*
+    and the drift gauges all land in ONE snapshot, and the per-segment
+    warm-hit probes read counters directly instead of scraping /metrics.
+    Returns ``(slo_section, run_info, registry_snapshot, recorder)``;
+    the recorder is already stopped."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from delphi_tpu import observability as obs
+    from delphi_tpu.observability import load as loadgen
+    from delphi_tpu.observability.fleet import (AutoscalePolicy,
+                                                FleetAutoscaler,
+                                                FleetRouter)
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("DELPHI_COMPILE_CACHE_DIR", "DELPHI_RETRY_BASE_S",
+                  "DELPHI_COMPILE_CACHE_MIN_S")}
+    os.environ["DELPHI_RETRY_BASE_S"] = "0.001"
+    os.environ["DELPHI_COMPILE_CACHE_MIN_S"] = "0"
+    cache_dir = tempfile.mkdtemp(prefix=f"delphi_{label}_")
+    os.environ["DELPHI_COMPILE_CACHE_DIR"] = os.path.join(cache_dir,
+                                                          "compile")
+
+    _heartbeat(f"{label}: synthesizing {fingerprints} fingerprints x "
+               f"{rows} rows from the gauntlet generators")
+    tables = loadgen.make_tables(fingerprints, rows, seed,
+                                 scenarios=scenarios)
+    segments = loadgen.default_segments(requests, rate_rps, spike_x)
+    schedule = loadgen.build_schedule(segments, fingerprints, zipf_alpha,
+                                      mix, seed)
+
+    rec = obs.start_recording(f"bench.{label}")
+    router = FleetRouter(
+        port=0, workers=workers, cache_dir=cache_dir, heartbeat_s=0.5,
+        worker_env={
+            # workers must come up on the CPU backend no matter what a
+            # fresh interpreter would otherwise pick
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": None,
+            "DELPHI_MESH": "off",
+            "DELPHI_FLEET_HEARTBEAT_S": "0.5",
+            # one repair thread + a short queue per worker: queue-depth
+            # pressure (the autoscale signal) builds at smoke-scale rates
+            "DELPHI_SERVE_WORKERS": "1",
+            "DELPHI_SERVE_QUEUE_DEPTH": "8",
+            "DELPHI_SERVE_RETRY_AFTER_S": "1",
+        })
+    scaler = None
+    kill_info = None
+    segment_counters = {}
+    prev_counters = [{}]
+    current_segment = [None]
+
+    def counters_now():
+        return dict(rec.registry.snapshot()["counters"]) if rec else {}
+
+    def post(body, timeout=180):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/repair",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}"), \
+                    dict(e.headers or {})
+            except (ValueError, json.JSONDecodeError):
+                return e.code, {}, dict(e.headers or {})
+        except Exception:
+            return None, {}, {}
+
+    def close_segment(next_name):
+        now = counters_now()
+        if current_segment[0] is not None:
+            prev = prev_counters[0]
+            segment_counters[current_segment[0]] = {
+                k: v - prev.get(k, 0) for k, v in now.items()
+                if v != prev.get(k, 0)}
+        prev_counters[0] = now
+        current_segment[0] = next_name
+
+    def on_segment(name):
+        _heartbeat(f"{label}: segment {name}")
+        close_segment(name)
+        if name == "post_kill" and kill_original_worker:
+            # hard-kill one of the ORIGINAL workers right at the segment
+            # boundary: its in-flight requests become dispatch faults the
+            # router re-dispatches, and the post_kill bucket measures the
+            # shrunken (or autoscaled-back) fleet
+            live = router.refresh_membership()
+            originals = [w for w in ("0", "1") if w in live]
+            if originals:
+                victim = originals[-1]
+                proc = router._procs.get(victim)
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    kill_info.update(worker=victim, at_segment=name)
+                    _heartbeat(f"{label}: killed worker {victim}")
+
+    kill_info = {"worker": None, "at_segment": None}
+    try:
+        _heartbeat(f"{label}: starting {workers}-worker fleet")
+        router.start()
+        if autoscale:
+            scaler = FleetAutoscaler(
+                router, policy=AutoscalePolicy(**autoscale),
+                interval_s=autoscale_interval_s).start()
+        runner = loadgen.OpenLoopRunner(
+            schedule, tables, lambda p: post(p),
+            retry_max=retry_max, on_segment=on_segment)
+        _heartbeat(f"{label}: open-loop run, {len(schedule)} arrivals "
+                   f"over {sum(s.duration_s for s in segments):.0f}s")
+        records = runner.run()
+        if scaler is not None:
+            scaler.stop()
+        close_segment(None)  # flush the final segment's counter delta
+        slo = loadgen.slo_section(
+            records, segments, runner.duration_s,
+            segment_counters=segment_counters,
+            autoscale_events=scaler.events if scaler else [],
+            kill=kill_info if kill_info["worker"] else None,
+            recovery_fail_over=recovery_fail_over)
+        if rec is not None:
+            rec.slo = slo
+        snapshot = rec.registry.snapshot() if rec else {"counters": {},
+                                                        "gauges": {}}
+        info = {
+            "arrivals": len(schedule),
+            "fingerprints": fingerprints,
+            "workers_started": workers,
+            "workers_final": router.refresh_membership(),
+            "cache_dir": cache_dir,
+        }
+        return slo, info, snapshot, rec
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        router.drain()
+        if rec is not None:
+            obs.stop_recording(rec)
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def load_smoke() -> int:
+    """Sustained-load + autoscale A/B at smoke scale: a ~60-request
+    deterministic open-loop run (seeded zipf over 8 gauntlet-generated
+    fingerprints, mixed batch/incremental/stream) against a 2-worker
+    spawned fleet with the queue-driven autoscaler armed. Asserts:
+
+    * the run report's ``slo`` section exists and is internally
+      consistent — every scheduled request accounted for
+      (sent == answered + shed + gave_up), per-segment buckets present;
+    * the spike's sustained queue pressure makes the autoscaler fire
+      EXACTLY once (cooldown ≫ run length blocks any second action);
+    * a worker hard-killed at the post_kill boundary doesn't break
+      accounting (the router re-dispatches; zero silent drops);
+    * a synthetically degraded baseline trips the new ``evaluate_slo``
+      drift gate while the self-baseline passes it.
+
+    Prints one JSON line; exit code 1 on failure."""
+    from delphi_tpu.observability import drift
+
+    slo, info, snapshot, rec = _run_load(
+        requests=60, fingerprints=8, rows=24, rate_rps=6.0, spike_x=5.0,
+        zipf_alpha=1.1,
+        mix={"batch": 0.7, "incremental": 0.15, "stream": 0.15},
+        retry_max=2, workers=2, seed=17,
+        autoscale={"min_workers": 2, "max_workers": 3,
+                   "up_queue_depth": 2, "down_queue_depth": 0,
+                   "sustain_ticks": 2, "cooldown_s": 3600.0},
+        autoscale_interval_s=0.25,
+        kill_original_worker=True,
+        # one scenario family = one table shape = one compile per
+        # worker; fingerprints stay distinct (seeded data), but tier-1
+        # wall time isn't dominated by five cold XLA compiles
+        scenarios=["fd_categorical"],
+        # smoke-scale latencies on a cold CPU fleet wobble hard; the
+        # intra-run recovery verdict is informational here (the full
+        # --load run is where it gates)
+        recovery_fail_over=50.0,
+        label="load_smoke")
+
+    counters = snapshot["counters"]
+    requests_acct = slo["requests"]
+    # the drift gate, both ways: the run against itself must pass, and a
+    # synthetically-degraded baseline (we claim the baseline was 3x
+    # faster at 3x the throughput with zero shed) must trip it
+    self_report = {"slo": slo}
+    p99 = slo["latency"]["p99"] or 0.1
+    degraded_baseline = {"slo": {
+        "requests": dict(requests_acct),  # else baseline_missing disarms
+        "qps": (slo["qps"] or 1.0) * 3.0,
+        "shed_rate": 0.0,
+        "latency": dict(slo["latency"], p99=p99 / 3.0),
+        "per_segment": {},
+    }}
+    gate_self = drift.evaluate_slo(slo, self_report, fail_over=0.2)
+    gate_degraded = drift.evaluate_slo(slo, degraded_baseline,
+                                       fail_over=0.2)
+
+    checks = {
+        "slo_present": bool(slo and slo.get("requests")),
+        "accounting_consistent": bool(slo.get("consistent"))
+            and requests_acct["sent"] == info["arrivals"],
+        "all_segments_bucketed": all(
+            name in slo["per_segment"] for name in
+            ("warmup", "steady", "spike", "post_kill")),
+        "fingerprints_mixed": slo["distinct_fingerprints"] >= 4
+            and set(slo["mix"]) == {"batch", "incremental", "stream"},
+        "latency_measured": (slo["latency"]["count"] or 0) > 0
+            and slo["latency"]["p99"] is not None,
+        "worker_attribution": any(slo["per_worker"]),
+        "autoscale_fired_exactly_once":
+            counters.get("autoscale.up", 0) == 1
+            and counters.get("autoscale.down", 0) == 0,
+        "worker_killed": bool(slo.get("kill"))
+            and slo["kill"]["at_segment"] == "post_kill",
+        "self_baseline_passes": not gate_self["failed"],
+        "degraded_baseline_trips": bool(gate_degraded["failed"]),
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "load_smoke", "value": 1 if ok else 0, "unit": "pass",
+        "vs_baseline": None, "ok": ok, "checks": checks,
+        "requests": requests_acct, "qps": slo["qps"],
+        "p50_s": slo["latency"]["p50"], "p99_s": slo["latency"]["p99"],
+        "shed_rate": slo["shed_rate"],
+        "warm_hit_ratio": slo["warm_hit_ratio"],
+        "autoscale_events": slo["autoscale"]["events"],
+        "kill": slo["kill"],
+        "degraded_gate_severity": gate_degraded["max_severity"],
+        "recovery": slo["recovery"],
+    }), flush=True)
+    if not ok:
+        print(f"load smoke FAILED: {checks}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def load_run() -> int:
+    """`bench.py --load`: the full sustained-load SLO run — a >=1k-request
+    open-loop schedule (>=100 zipf-weighted gauntlet fingerprints, mixed
+    batch/incremental/stream) against a spawned 2-worker fleet with the
+    queue-driven autoscaler armed, a forced spike segment, and a worker
+    hard-kill at the post_kill boundary. The run report (with its v9
+    ``slo`` section) lands at DELPHI_METRICS_PATH or BENCH_LOAD_r01.json;
+    DELPHI_LOAD_BASELINE (a prior such report) arms the SLO drift gate at
+    DELPHI_LOAD_FAIL_OVER. Exit 1 on accounting failure, a missed
+    recovery verdict, or a tripped gate. DELPHI_LOAD_* knobs size the
+    run."""
+    _force_cpu_backend()
+    from delphi_tpu import observability as obs
+    from delphi_tpu.observability import drift
+    from delphi_tpu.observability.load import load_knobs
+
+    knobs = load_knobs()
+    slo, info, snapshot, rec = _run_load(
+        requests=max(1000, knobs["requests"]),
+        fingerprints=max(100, knobs["fingerprints"]),
+        rows=knobs["rows"], rate_rps=knobs["rate_rps"],
+        spike_x=knobs["spike_x"], zipf_alpha=knobs["zipf_alpha"],
+        mix=knobs["mix"], retry_max=knobs["retry_max"],
+        workers=2, seed=knobs["seed"],
+        autoscale={"min_workers": 2, "max_workers": 4,
+                   "up_queue_depth": 3, "down_queue_depth": 0,
+                   "sustain_ticks": 3, "cooldown_s": 30.0},
+        autoscale_interval_s=0.5,
+        kill_original_worker=True,
+        recovery_fail_over=knobs["fail_over"],
+        label="load")
+
+    report = obs.build_run_report(rec, run={"bench": "load"})
+    report_path = os.environ.get("DELPHI_METRICS_PATH") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_LOAD_r01.json")
+    obs.write_run_report(report, report_path)
+
+    gate = None
+    if knobs["baseline"]:
+        gate = drift.evaluate_slo(slo, obs.load_run_report(
+            knobs["baseline"]), fail_over=knobs["fail_over"])
+
+    recovery = slo["recovery"]
+    checks = {
+        "accounting_consistent": bool(slo.get("consistent")),
+        "enough_fingerprints": slo["distinct_fingerprints"] >= 100,
+        "enough_requests": slo["requests"]["sent"] >= 1000,
+        "per_segment_slos": all(
+            (slo["per_segment"].get(n) or {}).get("latency", {}
+             ).get("p99") is not None
+            for n in ("warmup", "steady", "spike", "post_kill")),
+        "post_kill_recovered": recovery.get("post_kill_ok") in (True, None),
+        "gate_passed": not (gate or {}).get("failed"),
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "load", "value": slo["qps"], "unit": "qps",
+        "vs_baseline": (gate or {}).get("max_severity"), "ok": ok,
+        "checks": checks, "report": report_path,
+        "requests": slo["requests"],
+        "latency": slo["latency"], "shed_rate": slo["shed_rate"],
+        "warm_hit_ratio": slo["warm_hit_ratio"],
+        "per_segment": {n: {"qps": s["qps"], "p50_s": s["latency"]["p50"],
+                            "p99_s": s["latency"]["p99"],
+                            "shed_rate": s["shed_rate"],
+                            "warm_hit_ratio": s.get("warm_hit_ratio")}
+                        for n, s in slo["per_segment"].items()},
+        "autoscale_events": slo["autoscale"]["events"],
+        "kill": slo["kill"], "recovery": recovery,
+        **({"drift": {k: gate[k] for k in
+                      ("max_severity", "failed", "baseline_missing")}}
+           if gate else {}),
+    }), flush=True)
+    if not ok:
+        print(f"load run FAILED: {checks}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def load_smoke_entry() -> int:
+    """Standalone `bench.py --load-smoke` entry (CPU backend)."""
+    _force_cpu_backend()
+    return load_smoke()
+
+
 # Every artifact family the durable-store seam writes during one fully-armed
 # run, torn on its FIRST write. `store.fleet` rides the separate registration
 # scenario below and `store.fsck` is a read-side tag, so together the smoke
@@ -3194,6 +3537,26 @@ def main() -> None:
                              "acknowledged deltas lost and the end-state "
                              "bit-identical to a batch run; exits 1 on "
                              "failure")
+    parser.add_argument("--load", dest="load", action="store_true",
+                        help="sustained-load SLO run on the CPU backend: a "
+                             ">=1k-request deterministic open-loop schedule "
+                             "(>=100 zipf-weighted gauntlet fingerprints, "
+                             "mixed batch/incremental/stream, spike "
+                             "segment, worker hard-kill) against a spawned "
+                             "2-worker fleet with the queue-driven "
+                             "autoscaler armed; lands the v9 `slo` run "
+                             "report (BENCH_LOAD_r01.json) and gates "
+                             "against DELPHI_LOAD_BASELINE; exits 1 on "
+                             "accounting/recovery/gate failure")
+    parser.add_argument("--load-smoke", dest="load_smoke",
+                        action="store_true",
+                        help="~60-request sustained-load + autoscale smoke "
+                             "on a 2-worker fleet: slo section present and "
+                             "consistent (sent == answered + shed + "
+                             "gave_up), autoscale fires exactly once, a "
+                             "worker kill keeps accounting exact, and a "
+                             "degraded baseline trips the slo drift gate; "
+                             "exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -3240,6 +3603,12 @@ def main() -> None:
 
     if args.stream_chaos:
         sys.exit(stream_chaos())
+
+    if args.load:
+        sys.exit(load_run())
+
+    if args.load_smoke:
+        sys.exit(load_smoke_entry())
 
     if args._child:
         _child_main(args)

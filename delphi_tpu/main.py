@@ -229,6 +229,15 @@ def main(argv=None) -> int:
                              "--serve-port; docs/source/robustness.rst). "
                              "Equivalent to DELPHI_FLEET_WORKERS / "
                              "repair.fleet.workers")
+    parser.add_argument("--autoscale", dest="autoscale",
+                        action="store_true",
+                        help="with --fleet: enable the queue-driven "
+                             "autoscaler — spawn/retire workers from "
+                             "sustained queue-depth and stream-lag "
+                             "pressure, with hysteresis and cooldown "
+                             "(the DELPHI_AUTOSCALE knob family; "
+                             "docs/source/observability.rst). Equivalent "
+                             "to DELPHI_AUTOSCALE=1")
     parser.add_argument("--fsck", dest="fsck", type=str, default="",
                         metavar="ROOT",
                         help="scan a cache root through the durable-store "
@@ -472,7 +481,8 @@ def main(argv=None) -> int:
             session.conf["repair.fault.plan"] = args.fault_plan
         from delphi_tpu.observability.fleet import run_fleet
         return run_fleet(port=args.serve_port, workers=args.fleet,
-                         cache_dir=args.serve_cache_dir or None)
+                         cache_dir=args.serve_cache_dir or None,
+                         autoscale=args.autoscale or None)
     if args.serve:
         if args.fault_plan:
             session.conf["repair.fault.plan"] = args.fault_plan

@@ -275,3 +275,118 @@ def evaluate_gauntlet(current_gauntlet: Optional[Dict[str, Any]],
             "gauntlet drift gate FAILED: max severity {} > fail-over {}"
             .format(result["max_severity"], fail_over))
     return result
+
+
+# -- sustained-load SLO gate (v9 `slo` sections) -----------------------------
+#
+# The load harness measures what the fleet DELIVERS under open-loop
+# pressure: p99 latency, sustained QPS, shed rate. The gate scores the
+# current run's `slo` section against a baseline report's: a p99 that
+# doubled, a QPS that halved, or a shed rate that climbed is a serving
+# regression even when every repair is still bit-identical — quality
+# gates can't see it, this one exists to.
+
+#: p99 regressions are expressed as a fraction of the baseline p99 and can
+#: legitimately wobble run-to-run far more than QPS/shed do; halve the
+#: fraction onto the shared severity scale so one fail-over threshold
+#: governs all three signals (mirroring _GAP_SCALE above).
+_SLO_P99_SCALE = 0.5
+
+
+def _slo_signals(cur: Dict[str, Any], base: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+    """(positive = regression) drift of one slo bucket vs its baseline
+    counterpart: fractional p99 growth, fractional QPS drop, absolute
+    shed-rate increase. Severity is the worst of the three; improvements
+    never contribute."""
+    c_p99 = (cur.get("latency") or {}).get("p99")
+    b_p99 = (base.get("latency") or {}).get("p99")
+    p99_regression = max(0.0, (float(c_p99) - float(b_p99))
+                         / float(b_p99)) \
+        if c_p99 is not None and b_p99 and float(b_p99) > 0 else 0.0
+    c_qps, b_qps = cur.get("qps"), base.get("qps")
+    qps_drop = max(0.0, (float(b_qps) - float(c_qps)) / float(b_qps)) \
+        if c_qps is not None and b_qps and float(b_qps) > 0 else 0.0
+    shed_increase = max(0.0, float(cur.get("shed_rate") or 0.0)
+                        - float(base.get("shed_rate") or 0.0))
+    severity = max(_SLO_P99_SCALE * p99_regression, qps_drop,
+                   shed_increase)
+    return {
+        "p99_regression": round(p99_regression, 6),
+        "qps_drop": round(qps_drop, 6),
+        "shed_rate_increase": round(shed_increase, 6),
+        "severity": round(severity, 6),
+    }
+
+
+def compare_slo(current: Dict[str, Any],
+                baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """SLO drift between two run-report ``slo`` sections: the overall
+    bucket plus every segment present on both sides."""
+    per_segment: Dict[str, Any] = {}
+    cur_seg = current.get("per_segment") or {}
+    base_seg = baseline.get("per_segment") or {}
+    for name in sorted(set(cur_seg) | set(base_seg)):
+        c, b = cur_seg.get(name), base_seg.get(name)
+        if c is None or b is None:
+            per_segment[name] = {
+                "status": "missing_in_current" if c is None
+                else "missing_in_baseline"}
+            continue
+        per_segment[name] = _slo_signals(c, b)
+    overall = _slo_signals(current, baseline)
+    scored = [v for v in per_segment.values() if "severity" in v]
+    scored.append(overall)
+    return {
+        "overall": overall,
+        "per_segment": per_segment,
+        "max_p99_regression": round(
+            max(v["p99_regression"] for v in scored), 6),
+        "max_qps_drop": round(max(v["qps_drop"] for v in scored), 6),
+        "max_shed_rate_increase": round(
+            max(v["shed_rate_increase"] for v in scored), 6),
+        "max_severity": round(max(v["severity"] for v in scored), 6),
+    }
+
+
+def emit_slo_drift_gauges(registry: Any, drift: Dict[str, Any]) -> None:
+    overall = drift.get("overall") or {}
+    for key in ("p99_regression", "qps_drop", "shed_rate_increase"):
+        if key in overall:
+            registry.set_gauge(f"drift.slo.{key}", overall[key])
+    for name, v in drift.get("per_segment", {}).items():
+        if "severity" in v:
+            registry.set_gauge(f"drift.slo.{name}.severity", v["severity"])
+    registry.set_gauge("drift.slo.max_severity",
+                       drift.get("max_severity", 0.0))
+    if drift.get("failed") is not None:
+        registry.set_gauge("drift.slo.failed",
+                           1.0 if drift["failed"] else 0.0)
+
+
+def evaluate_slo(current_slo: Optional[Dict[str, Any]],
+                 baseline_report: Optional[Dict[str, Any]],
+                 fail_over: Optional[float] = None,
+                 registry: Any = None) -> Dict[str, Any]:
+    """The sustained-load SLO gate: compare against the baseline run
+    report's ``slo`` section, attach the fail verdict, emit gauges.
+
+    A baseline without an slo section (any pre-v9 report) flags
+    ``baseline_missing`` and never fails, mirroring :func:`evaluate`."""
+    baseline_s = (baseline_report or {}).get("slo") or {}
+    result = compare_slo(current_slo or {}, baseline_s)
+    result["baseline_missing"] = not baseline_s.get("requests")
+    result["fail_over"] = fail_over
+    result["failed"] = bool(
+        fail_over is not None and not result["baseline_missing"]
+        and result["max_severity"] > fail_over)
+    if registry is not None:
+        try:
+            emit_slo_drift_gauges(registry, result)
+        except Exception as e:
+            _logger.warning(f"failed to emit slo drift gauges: {e}")
+    if result["failed"]:
+        _logger.warning(
+            "slo drift gate FAILED: max severity {} > fail-over {}"
+            .format(result["max_severity"], fail_over))
+    return result

@@ -65,7 +65,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from delphi_tpu.observability import trace as _trace
-from delphi_tpu.observability.registry import counter_inc, gauge_set
+from delphi_tpu.observability.registry import (
+    counter_inc, counter_value, gauge_set,
+)
 from delphi_tpu.observability.serve import (
     _knob_float, _knob_int, chain_fingerprint, table_fingerprint,
 )
@@ -86,6 +88,9 @@ _SEED_COUNTERS = (
     "fleet.affinity.hits", "fleet.affinity.misses",
     "fleet.affinity.chain_hits",
     "fleet.registration_corrupt",
+    "autoscale.ticks", "autoscale.up", "autoscale.down",
+    "autoscale.blocked_cooldown", "autoscale.blocked_hysteresis",
+    "autoscale.blocked_limit",
     "trace.traces", "trace.joins", "trace.spans", "trace.exports",
     "launch.ledger.records", "launch.ledger.flushes",
     "launch.ledger.loads", "launch.ledger.consults",
@@ -533,6 +538,12 @@ class FleetRouter:
             else:
                 counter_inc("fleet.affinity.chain_hits" if chain
                             else "fleet.affinity.hits")
+            hits = counter_value("fleet.affinity.hits") \
+                + counter_value("fleet.affinity.chain_hits")
+            total = hits + counter_value("fleet.affinity.misses")
+            if total > 0:
+                gauge_set("fleet.affinity.hit_ratio",
+                          round(hits / total, 6))
             _trace.instant("fleet.redispatch" if hops > 1
                            else "fleet.dispatch", worker=wid, hop=hops)
             try:
@@ -706,6 +717,330 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 pass
 
 
+# -- queue-driven autoscaling ------------------------------------------------
+
+_DEF_AS_MIN = 1
+_DEF_AS_MAX = 4
+_DEF_AS_UP_QUEUE = 4
+_DEF_AS_DOWN_QUEUE = 0
+_DEF_AS_UP_LAG_ROWS = 512
+_DEF_AS_SUSTAIN = 3
+_DEF_AS_COOLDOWN_S = 30.0
+_DEF_AS_INTERVAL_S = 1.0
+
+
+class AutoscalePolicy:
+    """The pure scale decision — no threads, no HTTP, fully drivable by a
+    fake clock.
+
+    Signals per tick: the fleet's worst per-worker admission queue depth
+    and worst ``stream.lag_rows`` (one hot replica is a problem even when
+    the mean is fine). Three defenses against flapping:
+
+    * **hysteresis** — scale-up pressure needs ``queue >= up_queue_depth``
+      (or ``lag >= up_lag_rows``); scale-down needs
+      ``queue <= down_queue_depth`` AND no lag pressure. The band between
+      the thresholds resets both streaks;
+    * **sustain** — a decision fires only after ``sustain_ticks``
+      *consecutive* pressured ticks (one spiky scrape is not a trend);
+    * **cooldown** — after any action, further actions are blocked for
+      ``cooldown_s`` (the new worker needs time to warm and absorb load
+      before it can be judged).
+
+    ``observe`` returns ``(action, reason)`` with action one of ``"up"``
+    / ``"down"`` / ``"hold"``.
+    """
+
+    def __init__(self, min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 up_queue_depth: Optional[int] = None,
+                 down_queue_depth: Optional[int] = None,
+                 up_lag_rows: Optional[int] = None,
+                 sustain_ticks: Optional[int] = None,
+                 cooldown_s: Optional[float] = None) -> None:
+        def knob(value, env, opt, default):
+            return value if value is not None else _knob_int(env, opt,
+                                                             default)
+
+        self.min_workers = max(1, knob(min_workers, "DELPHI_AUTOSCALE_MIN",
+                                       "repair.autoscale.min", _DEF_AS_MIN))
+        self.max_workers = max(self.min_workers, knob(
+            max_workers, "DELPHI_AUTOSCALE_MAX", "repair.autoscale.max",
+            _DEF_AS_MAX))
+        self.up_queue_depth = knob(up_queue_depth,
+                                   "DELPHI_AUTOSCALE_UP_QUEUE",
+                                   "repair.autoscale.up_queue",
+                                   _DEF_AS_UP_QUEUE)
+        self.down_queue_depth = knob(down_queue_depth,
+                                     "DELPHI_AUTOSCALE_DOWN_QUEUE",
+                                     "repair.autoscale.down_queue",
+                                     _DEF_AS_DOWN_QUEUE)
+        self.up_lag_rows = knob(up_lag_rows, "DELPHI_AUTOSCALE_UP_LAG_ROWS",
+                                "repair.autoscale.up_lag_rows",
+                                _DEF_AS_UP_LAG_ROWS)
+        self.sustain_ticks = max(1, knob(sustain_ticks,
+                                         "DELPHI_AUTOSCALE_SUSTAIN",
+                                         "repair.autoscale.sustain",
+                                         _DEF_AS_SUSTAIN))
+        self.cooldown_s = cooldown_s if cooldown_s is not None \
+            else _knob_float("DELPHI_AUTOSCALE_COOLDOWN_S",
+                             "repair.autoscale.cooldown_s",
+                             _DEF_AS_COOLDOWN_S)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_at: Optional[float] = None
+
+    def _cooling(self, now: float) -> bool:
+        return self._last_action_at is not None \
+            and (now - self._last_action_at) < self.cooldown_s
+
+    def observe(self, now: float, queue_depth: int, lag_rows: int,
+                n_live: int) -> Tuple[str, str]:
+        counter_inc("autoscale.ticks")
+        up_pressure = queue_depth >= self.up_queue_depth \
+            or lag_rows >= self.up_lag_rows
+        down_pressure = queue_depth <= self.down_queue_depth \
+            and lag_rows < self.up_lag_rows
+        if up_pressure:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif down_pressure:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            # inside the hysteresis band: not hot enough to grow, not
+            # idle enough to shrink — and any built-up streak dies here
+            if self._up_streak or self._down_streak:
+                counter_inc("autoscale.blocked_hysteresis")
+            self._up_streak = self._down_streak = 0
+            return "hold", "hysteresis"
+        if up_pressure and self._up_streak >= self.sustain_ticks:
+            if n_live >= self.max_workers:
+                counter_inc("autoscale.blocked_limit")
+                return "hold", "at_max"
+            if self._cooling(now):
+                counter_inc("autoscale.blocked_cooldown")
+                return "hold", "cooldown"
+            self._up_streak = self._down_streak = 0
+            self._last_action_at = now
+            return "up", (f"queue_depth={queue_depth} "
+                          f">= {self.up_queue_depth}"
+                          if queue_depth >= self.up_queue_depth
+                          else f"lag_rows={lag_rows} "
+                               f">= {self.up_lag_rows}")
+        if down_pressure and self._down_streak >= self.sustain_ticks:
+            if n_live <= self.min_workers:
+                counter_inc("autoscale.blocked_limit")
+                return "hold", "at_min"
+            if self._cooling(now):
+                counter_inc("autoscale.blocked_cooldown")
+                return "hold", "cooldown"
+            self._up_streak = self._down_streak = 0
+            self._last_action_at = now
+            return "down", (f"queue_depth={queue_depth} "
+                            f"<= {self.down_queue_depth}")
+        return "hold", "building"
+
+
+class FleetAutoscaler:
+    """Closes the elasticity loop: polls every live worker's ``/healthz``
+    (queue depth, stream lag), feeds the worst-case signals through
+    :class:`AutoscalePolicy`, and acts on the router — scale-up spawns
+    the next worker id (it registers and rendezvous-joins the ring
+    elastically), scale-down picks the highest-id live worker and
+    retires it GRACEFULLY: POST ``/drain`` (the worker unregisters and
+    hands back its stream cursors before refusing a single request),
+    wait for its clean departure from the ring, then SIGTERM the
+    process. Never SIGKILL on the happy path — a killed worker loses
+    nothing durable, but a drained one sheds nothing at all.
+
+    Every decision lands in ``autoscale.*`` counters; every action is a
+    trace instant (:func:`trace.background_instant`) and a structured
+    entry on :attr:`events`, which the load harness rolls into the run
+    report's ``slo.autoscale`` section.
+    """
+
+    def __init__(self, router: FleetRouter,
+                 policy: Optional[AutoscalePolicy] = None,
+                 interval_s: Optional[float] = None,
+                 now_fn=time.monotonic) -> None:
+        self.router = router
+        self.policy = policy or AutoscalePolicy()
+        self.interval_s = interval_s if interval_s is not None \
+            else _knob_float("DELPHI_AUTOSCALE_INTERVAL_S",
+                             "repair.autoscale.interval_s",
+                             _DEF_AS_INTERVAL_S)
+        self.now_fn = now_fn
+        self.events: List[Dict[str, Any]] = []
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # seams (overridden by tests to script worker health / drain) ---------
+
+    def _http_once(self, port: int, path: str, method: str = "GET",
+                   timeout_s: float = 5.0,
+                   site: str = "autoscale.http") -> Optional[Dict[str, Any]]:
+        """The ONE place autoscaler→worker HTTP happens (health polls and
+        drain posts — never repair dispatch, which stays on the router's
+        ``fleet.dispatch`` seam). Chaos-injectable at ``autoscale.http``;
+        any failure means "no signal this tick", never an exception — the
+        membership scan, not the autoscaler, declares workers dead."""
+        from delphi_tpu.parallel import resilience
+        try:
+            resilience._maybe_inject("autoscale.http")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{int(port)}{path}",
+                data=b"" if method == "POST" else None, method=method)
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except Exception:
+            return None
+
+    def _poll_worker(self, port: int) -> Optional[Dict[str, Any]]:
+        return self._http_once(port, "/healthz")
+
+    def _post_drain(self, port: int) -> bool:
+        return self._http_once(port, "/drain", method="POST",
+                               timeout_s=10.0) is not None
+
+    # signal collection ---------------------------------------------------
+
+    def collect(self) -> Tuple[int, int, int]:
+        """(worst queue_depth, worst lag_rows, live count) across the
+        ring. A worker that fails its poll contributes nothing — the
+        membership scan, not the autoscaler, decides whether it is
+        dead."""
+        live = self.router.refresh_membership()
+        queue_depth = lag_rows = 0
+        with self.router._lock:
+            ports = {wid: (self.router._workers.get(wid) or {}).get("port")
+                     for wid in live}
+        for wid, port in ports.items():
+            if not port:
+                continue
+            health = self._poll_worker(int(port))
+            if not health:
+                continue
+            queue_depth = max(queue_depth,
+                              int(health.get("queue_depth") or 0))
+            lag_rows = max(lag_rows, int(
+                (health.get("streams") or {}).get("lag_rows") or 0))
+        gauge_set("autoscale.queue_depth", queue_depth)
+        gauge_set("autoscale.lag_rows", lag_rows)
+        return queue_depth, lag_rows, len(live)
+
+    # actions --------------------------------------------------------------
+
+    def _event(self, action: str, reason: str, worker: Optional[str],
+               **extra: Any) -> None:
+        event = {"action": action, "reason": reason, "worker": worker,
+                 "at_s": round(self.now_fn(), 3)}
+        event.update(extra)
+        self.events.append(event)
+        trace_id = _trace.background_instant(f"autoscale.{action}",
+                                             reason=reason, worker=worker)
+        if trace_id:
+            event["trace_id"] = trace_id
+
+    def _next_worker_id(self) -> str:
+        with self.router._lock:
+            known = set(self.router._workers) | set(self.router._procs)
+        numeric = [int(w) for w in known if str(w).isdigit()]
+        return str(max(numeric) + 1 if numeric else len(known))
+
+    def scale_up(self, reason: str) -> Optional[str]:
+        wid = self._next_worker_id()
+        try:
+            self.router._spawn_worker(wid)
+        except Exception as e:
+            _logger.warning(f"autoscale spawn of worker {wid} failed: {e}")
+            return None
+        counter_inc("autoscale.up")
+        self._event("up", reason, wid)
+        _logger.info(f"autoscale: spawned worker {wid} ({reason})")
+        return wid
+
+    def _pick_victim(self) -> Optional[str]:
+        """Retire the highest worker id: with ids handed out in spawn
+        order that is the youngest (coldest) replica, and its departure
+        remaps the fewest long-lived warm fingerprints."""
+        live = self.router.refresh_membership()
+        if len(live) <= self.policy.min_workers:
+            return None
+        return sorted(live, key=lambda w: (len(w), w))[-1]
+
+    def scale_down(self, reason: str,
+                   depart_timeout_s: float = 30.0) -> Optional[str]:
+        wid = self._pick_victim()
+        if wid is None:
+            return None
+        with self.router._lock:
+            port = (self.router._workers.get(wid) or {}).get("port")
+        drained = bool(port) and self._post_drain(int(port))
+        if drained:
+            # the worker unregistered before its drain response; wait for
+            # the membership scan to see the clean departure
+            deadline = time.monotonic() + depart_timeout_s
+            while time.monotonic() < deadline:
+                if wid not in self.router.refresh_membership():
+                    break
+                time.sleep(0.1)
+        proc = self.router._procs.get(wid)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                proc.wait(timeout=depart_timeout_s)
+            except subprocess.TimeoutExpired:
+                _logger.warning(f"autoscale victim {wid} ignored SIGTERM; "
+                                "killing")
+                proc.kill()
+        counter_inc("autoscale.down")
+        self._event("down", reason, wid, drained=drained)
+        _logger.info(f"autoscale: retired worker {wid} "
+                     f"(drained={drained}, {reason})")
+        return wid
+
+    # loop -----------------------------------------------------------------
+
+    def tick(self) -> Tuple[str, str]:
+        queue_depth, lag_rows, n_live = self.collect()
+        action, reason = self.policy.observe(self.now_fn(), queue_depth,
+                                             lag_rows, n_live)
+        if action == "up":
+            self.scale_up(reason)
+        elif action == "down":
+            self.scale_down(reason)
+        return action, reason
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # scaling must never kill the router
+                _logger.warning(f"autoscale tick failed: {e}")
+
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="delphi-fleet-autoscaler")
+        self._thread.start()
+        _logger.info(
+            f"fleet autoscaler on (min={self.policy.min_workers}, "
+            f"max={self.policy.max_workers}, "
+            f"up_queue={self.policy.up_queue_depth}, "
+            f"sustain={self.policy.sustain_ticks}, "
+            f"cooldown={self.policy.cooldown_s}s)")
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
 def install_signal_handlers(router: FleetRouter) -> None:
     """SIGTERM/SIGINT → drain the whole fleet (main-thread only)."""
     def _handler(signum: int, frame: Any) -> None:
@@ -718,14 +1053,24 @@ def install_signal_handlers(router: FleetRouter) -> None:
 
 
 def run_fleet(port: int = 8080, workers: Optional[int] = None,
-              cache_dir: Optional[str] = None) -> int:
+              cache_dir: Optional[str] = None,
+              autoscale: Optional[bool] = None) -> int:
     """Blocking entry point for ``main.py --fleet N``: spawns the
-    workers, starts the router, and waits until a drain completes."""
+    workers, starts the router (plus the queue-driven autoscaler when
+    ``autoscale`` — or ``DELPHI_AUTOSCALE=1`` — asks for it), and waits
+    until a drain completes."""
+    if autoscale is None:
+        autoscale = str(os.environ.get("DELPHI_AUTOSCALE") or "").lower() \
+            in ("1", "on", "true", "yes")
     router = FleetRouter(port=port, workers=workers, cache_dir=cache_dir)
     router.start()
+    scaler = FleetAutoscaler(router).start() if autoscale else None
     install_signal_handlers(router)
     print(f"delphi repair fleet on 127.0.0.1:{router.port} "
-          f"({router.n_workers} workers, cache {router.cache_dir})",
-          flush=True)
+          f"({router.n_workers} workers, "
+          f"autoscale {'on' if scaler else 'off'}, "
+          f"cache {router.cache_dir})", flush=True)
     router.wait()
+    if scaler is not None:
+        scaler.stop()
     return 0

@@ -53,7 +53,7 @@ import threading
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from delphi_tpu.utils import setup_logger
 
@@ -368,6 +368,27 @@ def _rss_gb() -> Optional[float]:
     return None
 
 
+# Extra per-sample probes other planes register (the serve plane re-samples
+# its admission gauges — serve.queue_depth / serve.in_flight /
+# serve.shed_ratio — so a /metrics scrape between requests stays current).
+# Each hook runs inside the sampler's try/except: a broken probe degrades
+# to a debug log, never stops resource sampling.
+_sample_hooks_lock = threading.Lock()
+_sample_hooks: List[Callable[[], None]] = []
+
+
+def register_sample_hook(fn: Callable[[], None]) -> None:
+    with _sample_hooks_lock:
+        if fn not in _sample_hooks:
+            _sample_hooks.append(fn)
+
+
+def unregister_sample_hook(fn: Callable[[], None]) -> None:
+    with _sample_hooks_lock:
+        if fn in _sample_hooks:
+            _sample_hooks.remove(fn)
+
+
 class _ResourceSampler(threading.Thread):
     """Periodic process/device resource gauges: RSS, per-device HBM
     bytes-in-use. Paired with the compile-time listener this answers 'what
@@ -386,6 +407,13 @@ class _ResourceSampler(threading.Thread):
                 _logger.debug(f"resource sample failed: {e}")
 
     def _sample(self) -> None:
+        with _sample_hooks_lock:
+            hooks = list(_sample_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception as e:
+                _logger.debug(f"sample hook failed: {e}")
         reg = self._plane.recorder.registry
         rss = _rss_gb()
         if rss is not None:

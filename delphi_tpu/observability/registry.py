@@ -111,6 +111,15 @@ class MetricsRegistry:
                 hist = self._histograms[name] = _Histogram(name)
             hist.observe(float(value))
 
+    def counter_value(self, name: str) -> float:
+        with self._lock:
+            return float(self._counters.get(name, 0))
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        with self._lock:
+            value = self._gauges.get(name)
+            return None if value is None else float(value)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -216,3 +225,11 @@ def histogram_observe(name: str, value: Number) -> None:
     reg = _active_registry()
     if reg is not None:
         reg.observe(name, value)
+
+
+def counter_value(name: str) -> float:
+    """Current value of a counter on the active registry (0.0 when no
+    recorder is active) — lets ratio gauges like ``serve.shed_ratio`` be
+    derived from their component counters at the increment site."""
+    reg = _active_registry()
+    return reg.counter_value(name) if reg is not None else 0.0

@@ -51,6 +51,19 @@ Schema (version 8; version 1-7 reports still load, see
             count, wall_s, device_s, useful_units, padded_units,
             signature}}}},
         "buckets": 0, "wall_s": 0.0, "device_s": 0.0
+      },
+      "slo": null | {                              # v9+: sustained-load SLOs
+        "requests": {sent, answered, ok, failed, shed, gave_up, retries},
+        "consistent": true,            # sent == answered + shed + gave_up
+        "qps": 0.0, "shed_rate": 0.0,
+        "latency": {count, mean, p50, p90, p99},
+        "warm_hit_ratio": null | 0.0,
+        "per_worker": {"<wid>": {requests, share}},
+        "per_segment": {"<segment>": {...same shape...}},
+        "segments": [{name, duration_s, rate_rps}, ...],
+        "recovery": {fail_over, steady_p99_s, ..., violations},
+        "autoscale": {"events": [{action, reason, worker, at_s}, ...]},
+        "kill": null | {worker, at_segment}
       }
     }
 
@@ -76,8 +89,8 @@ from delphi_tpu.utils import setup_logger
 
 _logger = setup_logger()
 
-REPORT_SCHEMA_VERSION = 8
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+REPORT_SCHEMA_VERSION = 9
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 REPORT_KIND = "delphi_tpu.run_report"
 
 Interval = Tuple[int, int]
@@ -372,6 +385,7 @@ def build_run_report(recorder: Any,
         "gauntlet": getattr(recorder, "gauntlet", None),
         "trace": _trace_section(recorder),
         "launch_costs": _launch_costs_section(recorder),
+        "slo": getattr(recorder, "slo", None),
     }
 
 
@@ -410,15 +424,16 @@ def write_run_report(report: Dict[str, Any], path: str) -> None:
 
 
 def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
-    """In-memory v1..v7 -> v8 upgrade: each version only adds keys
+    """In-memory v1..v8 -> v9 upgrade: each version only adds keys
     (v2 added ``per_process``, v3 added ``scorecards`` and ``drift``, v4
     added ``incremental``, v5 added ``escalation``, v6 added ``dist`` —
     the distributed-resilience section, v7 added ``gauntlet`` — the
     scenario-gauntlet quality section, v8 added ``trace`` and
     ``launch_costs`` — the distributed-trace identity and per-launch
-    device-cost ledger), so an older report becomes a valid v8 one by
-    defaulting them. Consumers can rely on the v8 shape regardless of
-    the file's age."""
+    device-cost ledger, v9 added ``slo`` — the sustained-load SLO
+    ledger), so an older report becomes a valid v9 one by defaulting
+    them. Consumers can rely on the v9 shape regardless of the file's
+    age."""
     version = report.get("schema_version")
     if version == REPORT_SCHEMA_VERSION:
         return report
@@ -432,6 +447,7 @@ def upgrade_run_report(report: Dict[str, Any]) -> Dict[str, Any]:
     report.setdefault("gauntlet", None)      # v6 -> v7
     report.setdefault("trace", None)         # v7 -> v8
     report.setdefault("launch_costs", None)  # v7 -> v8
+    report.setdefault("slo", None)           # v8 -> v9
     report["schema_version"] = REPORT_SCHEMA_VERSION
     report["schema_version_loaded_from"] = version
     return report

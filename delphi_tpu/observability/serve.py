@@ -202,6 +202,12 @@ _SEED_COUNTERS = (
     "gauntlet.scenarios", "gauntlet.scenario_errors",
     "gauntlet.cells_injected", "gauntlet.repairs",
     "gauntlet.repairs_correct",
+    "load.requests", "load.answered", "load.ok", "load.failed",
+    "load.shed", "load.gave_up", "load.retries",
+    "slo.segments", "slo.recovery_violations",
+    "autoscale.ticks", "autoscale.up", "autoscale.down",
+    "autoscale.blocked_cooldown", "autoscale.blocked_hysteresis",
+    "autoscale.blocked_limit",
 )
 
 
@@ -381,6 +387,7 @@ class RepairServer:
             counter_inc(name, 0)
         gauge_set("serve.queue_depth", 0)
         gauge_set("serve.in_flight", 0)
+        gauge_set("serve.shed_ratio", 0)
         gauge_set("serve.draining", 0)
         gauge_set("stream.lag_rows", 0)
         gauge_set("stream.active", 0)
@@ -408,6 +415,8 @@ class RepairServer:
             name="delphi-serve-http")
         self._http_thread.start()
         self._register_fleet_worker()
+        from delphi_tpu.observability import live as _live
+        _live.register_sample_hook(self._sample_admission)
         _logger.info(
             f"repair service listening on 127.0.0.1:{self.port} "
             f"(workers={self.workers}, queue={self.queue_depth}, "
@@ -570,6 +579,8 @@ class RepairServer:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        from delphi_tpu.observability import live as _live
+        _live.unregister_sample_hook(self._sample_admission)
         self.unregister_fleet_worker()
         for _ in self._workers:
             try:
@@ -615,6 +626,21 @@ class RepairServer:
 
     # -- admission -----------------------------------------------------------
 
+    def _sample_admission(self) -> None:
+        """Re-samples the admission gauges outside the request path —
+        registered with the live plane's resource sampler so a /metrics
+        scrape on an idle (or wedged) server still reflects the current
+        queue, not the last request's view. Also the one place
+        ``serve.shed_ratio`` is derived from its component counters."""
+        from delphi_tpu.observability.registry import counter_value
+        gauge_set("serve.queue_depth", self._queue.qsize())
+        with self._lock:
+            gauge_set("serve.in_flight", self._in_flight)
+        requests = counter_value("serve.requests")
+        if requests > 0:
+            gauge_set("serve.shed_ratio",
+                      round(counter_value("serve.shed") / requests, 6))
+
     def submit(self, payload: Dict[str, Any]) -> RepairJob:
         """Admission control: draining → 503, overload (RSS / wedged
         heartbeat / full queue) → 429 with Retry-After. Returns the queued
@@ -631,6 +657,7 @@ class RepairServer:
             rss = _rss_gb()
             if rss is not None and rss > self.max_rss_gb:
                 counter_inc("serve.shed")
+                self._sample_admission()
                 raise Rejection(
                     429, f"process RSS {rss:.2f} GiB over the "
                          f"{self.max_rss_gb:.2f} GiB admission limit",
@@ -641,6 +668,7 @@ class RepairServer:
             idle = time.perf_counter() - self.recorder.last_transition
             if busy and idle > self.stall_shed_s:
                 counter_inc("serve.shed")
+                self._sample_admission()
                 raise Rejection(
                     429, f"in-flight work wedged ({idle:.0f}s without a "
                          "span heartbeat)",
@@ -683,6 +711,7 @@ class RepairServer:
                 self.streams.release(stream_req.get("id"),
                                      _stream_rows(payload))
             counter_inc("serve.shed")
+            self._sample_admission()
             raise Rejection(429, "admission queue full",
                             retry_after_s=self.retry_after_s)
         counter_inc("serve.accepted")

@@ -330,6 +330,19 @@ def instant(name: str, **args: Any) -> None:
         "args": payload})
 
 
+def background_instant(name: str, **args: Any) -> Optional[str]:
+    """An instant event from OUTSIDE any request — autoscale decisions,
+    scale-up/scale-down lifecycle marks.  :func:`instant` deliberately
+    no-ops without an active scope, so this opens a one-event trace of
+    its own and flushes it immediately.  Returns the trace id, or None
+    when tracing is disabled / the id samples out."""
+    with request_scope() as ctx:
+        if ctx is None:
+            return None
+        instant(name, **args)
+        return ctx.trace_id
+
+
 # -- part-file export / merge ----------------------------------------------
 
 def _part_path(root: str, trace_id: str) -> str:

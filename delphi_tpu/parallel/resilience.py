@@ -349,6 +349,7 @@ KNOWN_SITES = frozenset({
     "freq.distinct",
     "freq.distinct_merge",
     "fleet.dispatch",
+    "autoscale.http",
     "domain.score",
     "domain.weak_label",
     "domain.bucket",

@@ -55,6 +55,15 @@
   re-dispatches, the survivor rebuilds the session from the durable
   cursor through the shared cache root and commits) — zero acknowledged
   deltas lost, end-state bit-identical to the batch reference.
+- ``bench.load_smoke``: the sustained-load SLO A/B — a deterministic
+  ~60-request open-loop schedule (seeded zipf fingerprints, mixed
+  batch/incremental/stream, forced spike segment) against a 2-worker
+  fleet with the queue-driven autoscaler armed and one worker
+  hard-killed at the post_kill boundary; the run report's ``slo``
+  section must account for EVERY scheduled request
+  (sent == answered + shed + gave_up), the autoscaler must fire exactly
+  once, and a synthetically degraded baseline must trip the
+  ``evaluate_slo`` drift gate while the self-baseline passes.
 
 All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
@@ -139,3 +148,7 @@ def test_stream_ab_bit_identical():
 
 def test_stream_chaos_failover_resumes_durable_cursor():
     assert bench.stream_chaos_smoke() == 0
+
+
+def test_sustained_load_slo_and_autoscale():
+    assert bench.load_smoke() == 0

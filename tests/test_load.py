@@ -1,0 +1,556 @@
+"""The sustained-load harness, in-process with fake clocks and scripted
+post functions — no sockets, no subprocesses:
+
+- the arrival schedule is deterministic per seed (byte-identical replay)
+  and respects the segment program, zipf popularity, and kind mix;
+- the runner is genuinely OPEN-LOOP: arrivals fire on schedule even when
+  every in-flight request is blocked (completions never back-pressure
+  the arrival clock), while chained lanes still serialize seq order;
+- the bounded-retry ladder honors Retry-After with deterministic
+  crc32-jittered backoff, and every terminal path lands in exactly one
+  outcome bucket (``sent == answered + shed + gave_up``);
+- the slo ledger attributes records to segments, the recovery gate reads
+  post_kill (not the spike itself), and the accounting identity holds;
+- AutoscalePolicy's decision table: sustain before any action,
+  hysteresis-band resets, cooldown blocks, min/max limits block;
+- FleetAutoscaler retires workers GRACEFULLY: /drain first, victim is
+  the highest id, and min_workers is a hard floor;
+- drift.evaluate_slo: baseline_missing never fails, a degraded current
+  run trips, an improved one does not.
+
+The full-stack version (real fleet, real kill, real autoscaler thread)
+is bench.load_smoke, exercised by tests/test_chaos_ab.py.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from delphi_tpu import observability as obs
+from delphi_tpu.observability import drift
+from delphi_tpu.observability import load as loadgen
+from delphi_tpu.observability.fleet import AutoscalePolicy, \
+    FleetAutoscaler, FleetRouter
+from delphi_tpu.parallel import dist_resilience as dr
+
+
+# -- workload synthesis -------------------------------------------------------
+
+def test_parse_mix_normalizes_and_rejects_unknown_kinds():
+    mix = loadgen.parse_mix("batch=3,incremental=1")
+    assert mix == {"batch": 0.75, "incremental": 0.25, "stream": 0.0}
+    assert loadgen.parse_mix("batch=0,stream=0") \
+        == {"batch": 1.0, "incremental": 0.0, "stream": 0.0}
+    with pytest.raises(ValueError, match="unknown load mix kind"):
+        loadgen.parse_mix("batch=1,bogus=1")
+
+
+def test_zipf_weights_are_monotone_hot_head():
+    w = loadgen.zipf_weights(50, 1.1)
+    assert w[0] == 1.0
+    assert all(a > b for a, b in zip(w, w[1:]))
+    # alpha=0 degrades to uniform: no popularity skew
+    assert set(loadgen.zipf_weights(5, 0.0)) == {1.0}
+
+
+def test_make_tables_deterministic_and_distinct():
+    a = loadgen.make_tables(6, rows=8, seed=3)
+    b = loadgen.make_tables(6, rows=8, seed=3)
+    assert a == b  # byte-identical replay per (n, rows, seed)
+    fingerprints = {str(t["table"]) for t in a}
+    assert len(fingerprints) == 6
+
+
+def test_build_schedule_is_deterministic_per_seed():
+    segments = loadgen.default_segments(200, rate_rps=10.0, spike_x=3.0)
+    mix = loadgen.parse_mix("batch=0.6,incremental=0.2,stream=0.2")
+    s1 = loadgen.build_schedule(segments, 40, 1.1, mix, seed=7)
+    s2 = loadgen.build_schedule(segments, 40, 1.1, mix, seed=7)
+    s3 = loadgen.build_schedule(segments, 40, 1.1, mix, seed=8)
+    assert s1 == s2
+    assert s1 != s3
+    # segment program: every arrival lands inside its segment window,
+    # arrival times are monotone, all kinds and many fingerprints appear
+    assert [a.at_s for a in s1] == sorted(a.at_s for a in s1)
+    assert {a.segment for a in s1} \
+        == {"warmup", "steady", "spike", "post_kill"}
+    assert {a.kind for a in s1} == {"batch", "incremental", "stream"}
+    assert len({a.fp_index for a in s1}) >= 10
+    # zipf: rank-0 must be the modal fingerprint
+    counts = {}
+    for a in s1:
+        counts[a.fp_index] = counts.get(a.fp_index, 0) + 1
+    assert max(counts, key=counts.get) == 0
+    # chained kinds carry per-lane 1-based seq with no gaps
+    lanes = {}
+    for a in s1:
+        if a.lane is not None:
+            lanes.setdefault(a.lane, []).append(a.seq)
+    assert lanes and all(v == list(range(1, len(v) + 1))
+                         for v in lanes.values())
+
+
+def test_build_payload_shapes_per_kind():
+    tables = loadgen.make_tables(2, rows=8, seed=0)
+    batch = loadgen.Arrival(0, 0.1, "steady", "batch", 0)
+    inc = loadgen.Arrival(1, 0.2, "steady", "incremental", 1, "i1", 2)
+    stream = loadgen.Arrival(2, 0.3, "steady", "stream", 0, "s0", 1)
+    b = loadgen.build_payload(batch, tables)
+    assert b["table"] == tables[0]["table"] and "stream" not in b
+    i = loadgen.build_payload(inc, tables)
+    assert i["base_snapshot"] == "load-i1"
+    s = loadgen.build_payload(stream, tables)
+    assert s["stream"] == {"id": "load-s0", "seq": 1}
+    row_id = tables[0]["row_id"]
+    assert len(s["table"][row_id]) < len(tables[0]["table"][row_id])
+
+
+# -- retry discipline ---------------------------------------------------------
+
+def test_backoff_is_deterministic_jittered_and_capped():
+    d1 = loadgen.backoff_s("load-5", 1, retry_after_s=2.0)
+    assert d1 == loadgen.backoff_s("load-5", 1, retry_after_s=2.0)
+    assert 1.0 <= d1 <= 2.0  # jitter into [0.5x, 1.0x] of the base
+    # attempt 2 doubles the base but the cap bounds it
+    assert loadgen.backoff_s("load-5", 2, retry_after_s=4.0, cap_s=5.0) \
+        <= 5.0
+    # different request ids de-synchronize their retries
+    assert loadgen.backoff_s("load-6", 1, retry_after_s=2.0) != d1
+
+
+class _Clock:
+    """A fake monotonic clock advanced only by sleeps."""
+
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def now(self):
+        with self._lock:
+            return self.t
+
+    def sleep(self, d):
+        with self._lock:
+            self.t += max(0.0, d)
+
+
+def _segments_one(n=10, rate=100.0):
+    return [loadgen.Segment("steady", n / rate, rate)]
+
+
+def _tables_one():
+    return [{"index": 0, "scenario": "s", "row_id": "tid",
+             "table": {"tid": ["1", "2", "3", "4"],
+                       "c0": ["a", "b", "c", "d"]}}]
+
+
+def test_retry_honors_retry_after_then_succeeds():
+    clock = _Clock()
+    sleeps = []
+
+    def sleep_spy(d):
+        sleeps.append(round(d, 6))
+        clock.sleep(d)
+
+    attempts = []
+
+    def post(payload):
+        attempts.append(payload["request_id"])
+        if len(attempts) < 3:
+            return 429, {"status": "rejected"}, {"Retry-After": "2"}
+        return 200, {"status": "ok", "worker_id": "0"}, {}
+
+    schedule = [loadgen.Arrival(0, 0.0, "steady", "batch", 0)]
+    rec = obs.start_recording("test.load.retry")
+    try:
+        runner = loadgen.OpenLoopRunner(
+            schedule, _tables_one(), post, retry_max=2,
+            now_fn=clock.now, sleep_fn=sleep_spy)
+        records = runner.run(join_timeout_s=30)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert [r.outcome for r in records] == ["ok"]
+    assert records[0].retries == 2
+    assert records[0].worker == "0"
+    assert counters.get("load.retries") == 2
+    assert counters.get("load.answered") == 1
+    # the two backoff sleeps are exactly the deterministic schedule:
+    # Retry-After=2 doubled per attempt, crc32-jittered per (rid, attempt)
+    expected = [loadgen.backoff_s("load-0", 1, 2.0),
+                loadgen.backoff_s("load-0", 2, 2.0)]
+    assert [s for s in sleeps if s in expected] == expected
+
+
+def test_exhausted_retries_and_dead_connections_are_explicit():
+    """Nothing is silently dropped: a forever-shedding server ends in
+    ``shed``, a dead connection in ``gave_up``, and the totals satisfy
+    sent == answered + shed + gave_up."""
+    clock = _Clock()
+
+    def post(payload):
+        idx = int(payload["request_id"].rsplit("-", 1)[1])
+        if idx == 0:
+            return 429, {"status": "rejected"}, {"Retry-After": "0"}
+        if idx == 1:
+            return None, {}, {}  # connection-level failure
+        return 200, {"status": "ok"}, {}
+
+    schedule = [loadgen.Arrival(i, i * 0.01, "steady", "batch", 0)
+                for i in range(3)]
+    rec = obs.start_recording("test.load.outcomes")
+    try:
+        runner = loadgen.OpenLoopRunner(
+            schedule, _tables_one(), post, retry_max=1,
+            now_fn=clock.now, sleep_fn=clock.sleep)
+        records = runner.run(join_timeout_s=30)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    outcomes = {r.index: r.outcome for r in records}
+    assert outcomes == {0: "shed", 1: "gave_up", 2: "ok"}
+    assert counters.get("load.shed") == 1
+    assert counters.get("load.gave_up") == 1
+    assert counters.get("load.requests") == 3
+    slo = loadgen.slo_section(records, _segments_one(3), 1.0)
+    r = slo["requests"]
+    assert slo["consistent"] is True
+    assert r["sent"] == r["answered"] + r["shed"] + r["gave_up"] == 3
+
+
+# -- the open-loop property ---------------------------------------------------
+
+def test_arrivals_fire_on_schedule_while_completions_are_blocked():
+    """The defining open-loop property: every batch arrival dispatches at
+    its scheduled time even though NO request has completed (they all
+    block on a gate a closed-loop client would be stuck behind)."""
+    clock = _Clock()
+    release = threading.Event()
+
+    def post(payload):
+        release.wait(timeout=30)
+        return 200, {"status": "ok"}, {}
+
+    schedule = [loadgen.Arrival(i, round(0.5 * i, 6), "steady", "batch", 0)
+                for i in range(6)]
+    runner = loadgen.OpenLoopRunner(
+        schedule, _tables_one(), post, retry_max=0,
+        now_fn=clock.now, sleep_fn=clock.sleep)
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(runner.run(join_timeout_s=30)),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while len(runner.dispatched_at) < 6 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(runner.dispatched_at) == 6, "arrivals were held back"
+    assert not runner.records, "nothing completed, yet all dispatched"
+    release.set()
+    t.join(timeout=30)
+    assert done and len(done[0]) == 6
+    # on the fake clock, dispatch time IS the scheduled time
+    for a in schedule:
+        assert runner.dispatched_at[a.index] == pytest.approx(a.at_s)
+
+
+def test_chained_lane_serializes_seq_order():
+    clock = _Clock()
+    seen = []
+    lock = threading.Lock()
+
+    def post(payload):
+        with lock:
+            seen.append(payload["stream"]["seq"])
+        return 200, {"status": "ok"}, {}
+
+    schedule = [loadgen.Arrival(i, 0.0, "steady", "stream", 0, "s0", i + 1)
+                for i in range(5)]
+    runner = loadgen.OpenLoopRunner(
+        schedule, _tables_one(), post, retry_max=0,
+        now_fn=clock.now, sleep_fn=clock.sleep)
+    runner.run(join_timeout_s=30)
+    assert seen == [1, 2, 3, 4, 5]
+
+
+def test_segment_probe_failures_never_stop_arrivals():
+    clock = _Clock()
+    fired = []
+
+    def on_segment(name):
+        fired.append(name)
+        raise RuntimeError("probe exploded")
+
+    schedule = [loadgen.Arrival(0, 0.0, "warmup", "batch", 0),
+                loadgen.Arrival(1, 0.1, "steady", "batch", 0)]
+    runner = loadgen.OpenLoopRunner(
+        schedule, _tables_one(),
+        lambda p: (200, {"status": "ok"}, {}), retry_max=0,
+        now_fn=clock.now, sleep_fn=clock.sleep, on_segment=on_segment)
+    records = runner.run(join_timeout_s=30)
+    assert fired == ["warmup", "steady"]
+    assert [r.outcome for r in records] == ["ok", "ok"]
+
+
+# -- the slo ledger -----------------------------------------------------------
+
+def _record(index, segment, outcome="ok", latency=0.05, worker="0",
+            kind="batch", fp=0, retries=0):
+    return loadgen.RequestRecord(
+        request_id=f"load-{index}", index=index, segment=segment,
+        kind=kind, fp_index=fp, scheduled_at_s=0.0, latency_s=latency,
+        status=200 if outcome in ("ok",) else 429, outcome=outcome,
+        worker=worker, retries=retries)
+
+
+def test_slo_section_segments_recovery_and_accounting():
+    segments = [loadgen.Segment("warmup", 1.0, 5.0),
+                loadgen.Segment("steady", 4.0, 5.0),
+                loadgen.Segment("spike", 1.0, 15.0),
+                loadgen.Segment("post_kill", 2.0, 5.0)]
+    records = (
+        [_record(i, "warmup") for i in range(3)]
+        + [_record(10 + i, "steady", latency=0.10, worker=str(i % 2))
+           for i in range(10)]
+        + [_record(30 + i, "spike", outcome="shed", worker=None)
+           for i in range(4)]
+        + [_record(50 + i, "post_kill", latency=0.50, kind="stream",
+                   fp=i) for i in range(5)])
+    seg_counters = {"steady": {"fleet.affinity.hits": 6,
+                               "fleet.affinity.chain_hits": 2,
+                               "fleet.affinity.misses": 2}}
+    slo = loadgen.slo_section(
+        records, segments, duration_s=8.0, segment_counters=seg_counters,
+        autoscale_events=[{"action": "up", "worker": "2"}],
+        kill={"worker": "1"}, recovery_fail_over=0.5)
+    assert slo["consistent"] is True
+    assert slo["requests"]["sent"] == 22
+    assert slo["requests"]["shed"] == 4
+    per = slo["per_segment"]
+    assert set(per) == {"warmup", "steady", "spike", "post_kill"}
+    assert sum(p["sent"] for p in per.values()) == slo["requests"]["sent"]
+    assert per["spike"]["shed"] == 4 and per["spike"]["answered"] == 0
+    assert per["steady"]["warm_hit_ratio"] == pytest.approx(0.8)
+    assert per["steady"]["per_worker"]["0"]["requests"] == 5
+    # the recovery gate reads post_kill, never the spike itself
+    rec = slo["recovery"]
+    assert "spike_ok" not in rec
+    assert rec["post_kill_ok"] is False  # 0.50 vs steady 0.10 = 4x
+    assert rec["violations"] == 1
+    assert slo["mix"] == {"batch": 17, "stream": 5}
+    assert slo["distinct_fingerprints"] == 5
+    assert slo["autoscale"]["events"] == [{"action": "up", "worker": "2"}]
+    assert slo["kill"] == {"worker": "1"}
+    # within the fail-over, post_kill recovers
+    ok = loadgen.slo_section(
+        [_record(0, "steady", latency=0.10),
+         _record(1, "post_kill", latency=0.12)],
+        segments, 8.0, recovery_fail_over=0.5)
+    assert ok["recovery"]["post_kill_ok"] is True
+    assert ok["recovery"]["violations"] == 0
+
+
+# -- the autoscale decision table ---------------------------------------------
+
+def _policy(**kw):
+    base = dict(min_workers=1, max_workers=4, up_queue_depth=4,
+                down_queue_depth=0, up_lag_rows=512, sustain_ticks=3,
+                cooldown_s=30.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def test_policy_scales_up_only_after_sustained_queue_pressure():
+    p = _policy()
+    assert p.observe(0.0, 5, 0, 2) == ("hold", "building")
+    assert p.observe(1.0, 5, 0, 2) == ("hold", "building")
+    action, reason = p.observe(2.0, 5, 0, 2)
+    assert action == "up" and "queue_depth=5" in reason
+
+
+def test_policy_lag_pressure_alone_scales_up():
+    p = _policy(sustain_ticks=1)
+    action, reason = p.observe(0.0, 0, 1000, 2)
+    assert action == "up" and "lag_rows=1000" in reason
+
+
+def test_policy_hysteresis_band_resets_streaks():
+    rec = obs.start_recording("test.autoscale.hysteresis")
+    try:
+        p = _policy()
+        p.observe(0.0, 5, 0, 2)
+        p.observe(1.0, 5, 0, 2)
+        # queue falls into the band (0 < 2 < 4): streak dies, no action
+        assert p.observe(2.0, 2, 0, 2) == ("hold", "hysteresis")
+        # pressure returns but must re-earn the full sustain window
+        assert p.observe(3.0, 5, 0, 2) == ("hold", "building")
+        assert p.observe(4.0, 5, 0, 2) == ("hold", "building")
+        assert p.observe(5.0, 5, 0, 2)[0] == "up"
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("autoscale.blocked_hysteresis") == 1
+    assert counters.get("autoscale.up", 0) == 0  # policy decides, never acts
+    assert counters.get("autoscale.ticks") == 6
+
+
+def test_policy_cooldown_blocks_consecutive_actions():
+    rec = obs.start_recording("test.autoscale.cooldown")
+    try:
+        p = _policy(sustain_ticks=1, cooldown_s=30.0)
+        assert p.observe(0.0, 5, 0, 2)[0] == "up"
+        # pressure persists, but the new worker needs time to absorb load
+        assert p.observe(1.0, 5, 0, 3) == ("hold", "cooldown")
+        assert p.observe(29.0, 5, 0, 3) == ("hold", "cooldown")
+        assert p.observe(31.0, 5, 0, 3)[0] == "up"
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert counters.get("autoscale.blocked_cooldown") == 2
+
+
+def test_policy_respects_min_and_max_limits():
+    p = _policy(sustain_ticks=1)
+    assert p.observe(0.0, 5, 0, 4) == ("hold", "at_max")
+    q = _policy(min_workers=2, sustain_ticks=1)
+    assert q.observe(0.0, 0, 0, 2) == ("hold", "at_min")
+    # one replica above the floor may retire
+    action, reason = q.observe(1.0, 0, 0, 3)
+    assert action == "down" and "queue_depth=0" in reason
+
+
+# -- the autoscaler's graceful scale-down -------------------------------------
+
+class _ScriptedAutoscaler(FleetAutoscaler):
+    """Seam overrides: no sockets — health polls and drain posts are
+    scripted, and a drained worker departs the ring immediately."""
+
+    def __init__(self, router, policy, health):
+        super().__init__(router, policy, interval_s=3600.0)
+        self.health = health  # port -> healthz dict
+        self.drained = []
+        self.spawned = []
+
+    def _poll_worker(self, port):
+        return self.health.get(port)
+
+    def _post_drain(self, port):
+        self.drained.append(port)
+        fleet_dir = self.router.fleet_dir
+        for wid, info in list(self.router._workers.items()):
+            if info.get("port") == port:
+                for path in (os.path.join(fleet_dir, f"worker_{wid}.json"),
+                             dr.member_liveness_path(fleet_dir, wid)):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        return True
+
+    def scale_up(self, reason):
+        self.spawned.append(reason)
+        return "spawned"
+
+
+def _register(fleet_dir, wid, port):
+    """Fake a worker registration + fresh liveness stamp (the on-disk
+    shape serve.RepairServer._register_fleet_worker writes)."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = os.path.join(fleet_dir, f"worker_{wid}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump({"worker_id": wid, "port": port, "pid": os.getpid(),
+                   "cache_dir": "", "started": 0.0}, f)
+    os.replace(path + ".tmp", path)
+    dr.touch_liveness_file(dr.member_liveness_path(fleet_dir, wid))
+
+
+@pytest.fixture
+def scripted_fleet(tmp_path):
+    router = FleetRouter(port=0, workers=2, cache_dir=str(tmp_path),
+                         spawn=False, heartbeat_s=1.0)
+    _register(router.fleet_dir, "0", 42001)
+    _register(router.fleet_dir, "1", 42002)
+    yield router
+    router.stop()
+
+
+def test_autoscaler_scale_down_drains_the_highest_id_first(scripted_fleet):
+    rec = obs.start_recording("test.autoscale.drain")
+    try:
+        scaler = _ScriptedAutoscaler(
+            scripted_fleet, _policy(min_workers=1, sustain_ticks=1,
+                                    cooldown_s=0.0),
+            health={42001: {"queue_depth": 0, "streams": {"lag_rows": 0}},
+                    42002: {"queue_depth": 0, "streams": {"lag_rows": 0}}})
+        victim = scaler.scale_down("test", depart_timeout_s=2.0)
+        counters = rec.registry.snapshot()["counters"]
+    finally:
+        obs.stop_recording(rec)
+    assert victim == "1"  # highest id = youngest/coldest replica
+    assert scaler.drained == [42002]  # /drain, never a kill
+    assert counters.get("autoscale.down") == 1
+    assert scaler.events and scaler.events[0]["action"] == "down"
+    assert scaler.events[0]["drained"] is True
+    assert "1" not in scripted_fleet.refresh_membership()
+
+
+def test_autoscaler_scale_down_respects_the_min_floor(scripted_fleet):
+    scaler = _ScriptedAutoscaler(
+        scripted_fleet, _policy(min_workers=2, sustain_ticks=1),
+        health={})
+    assert scaler.scale_down("test") is None
+    assert scaler.drained == []
+
+
+def test_autoscaler_tick_wires_worst_case_signals_to_actions(
+        scripted_fleet):
+    """collect() takes the WORST queue/lag across the ring (one hot
+    replica is a problem), and tick() routes the policy verdict to the
+    scale action."""
+    rec = obs.start_recording("test.autoscale.tick")
+    try:
+        scaler = _ScriptedAutoscaler(
+            scripted_fleet, _policy(sustain_ticks=1, cooldown_s=0.0),
+            health={42001: {"queue_depth": 0, "streams": {"lag_rows": 0}},
+                    42002: {"queue_depth": 9, "streams": {"lag_rows": 3}}})
+        assert scaler.collect() == (9, 3, 2)
+        action, reason = scaler.tick()
+        gauges = rec.registry.snapshot()["gauges"]
+    finally:
+        obs.stop_recording(rec)
+    assert action == "up" and scaler.spawned == [reason]
+    assert gauges.get("autoscale.queue_depth") == 9
+    assert gauges.get("autoscale.lag_rows") == 3
+
+
+# -- the drift gate -----------------------------------------------------------
+
+def _slo_fixture(p99=0.1, qps=50.0, shed=0.0):
+    return {"requests": {"sent": 100}, "qps": qps, "shed_rate": shed,
+            "latency": {"p99": p99},
+            "per_segment": {"steady": {"qps": qps, "shed_rate": shed,
+                                       "latency": {"p99": p99}}}}
+
+
+def test_evaluate_slo_missing_baseline_never_fails():
+    verdict = drift.evaluate_slo(_slo_fixture(), {"schema_version": 8},
+                                 fail_over=0.0)
+    assert verdict["baseline_missing"] is True
+    assert verdict["failed"] is False
+
+
+def test_evaluate_slo_degraded_current_trips_the_gate():
+    base = {"slo": _slo_fixture(p99=0.1, qps=50.0)}
+    bad = drift.evaluate_slo(_slo_fixture(p99=0.4, qps=20.0), base,
+                             fail_over=0.2)
+    assert bad["baseline_missing"] is False
+    assert bad["failed"] is True
+    assert bad["max_qps_drop"] == pytest.approx(0.6)
+    # improvements never contribute to severity
+    good = drift.evaluate_slo(_slo_fixture(p99=0.05, qps=80.0), base,
+                              fail_over=0.2)
+    assert good["failed"] is False
+    assert good["max_severity"] == 0.0

@@ -298,6 +298,22 @@ def test_v4_report_upgrades_on_load(tmp_path):
     assert loaded["escalation"] is None
 
 
+def test_v8_report_upgrades_on_load(tmp_path):
+    v8 = {"schema_version": 8, "kind": obs.REPORT_KIND, "status": "ok",
+          "metrics": {"counters": {}}, "spans": {"name": "r"},
+          "per_process": None, "scorecards": None, "drift": None,
+          "incremental": None, "escalation": None, "gauntlet": None,
+          "streams": None, "launch_costs": {"records": 3}}
+    path = tmp_path / "v8.json"
+    path.write_text(json.dumps(v8))
+    loaded = obs.load_run_report(str(path))
+    assert loaded is not None
+    assert loaded["schema_version"] == obs.REPORT_SCHEMA_VERSION
+    assert loaded["schema_version_loaded_from"] == 8
+    assert loaded["slo"] is None  # v9 backfill
+    assert loaded["launch_costs"] == {"records": 3}  # payload untouched
+
+
 def test_run_report_carries_escalation_summary():
     rec = obs.start_recording("esc_report")
     rec.escalation = {"requested": True, "routed": 2, "escalated": 1}

@@ -172,7 +172,17 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
                      "delphi_launch_ledger_flushes",
                      "delphi_launch_ledger_loads",
                      "delphi_launch_ledger_consults",
-                     "delphi_launch_ledger_merge_vetoes"):
+                     "delphi_launch_ledger_merge_vetoes",
+                     "delphi_load_requests", "delphi_load_answered",
+                     "delphi_load_ok", "delphi_load_failed",
+                     "delphi_load_shed", "delphi_load_gave_up",
+                     "delphi_load_retries", "delphi_slo_segments",
+                     "delphi_slo_recovery_violations",
+                     "delphi_autoscale_ticks", "delphi_autoscale_up",
+                     "delphi_autoscale_down",
+                     "delphi_autoscale_blocked_cooldown",
+                     "delphi_autoscale_blocked_hysteresis",
+                     "delphi_autoscale_blocked_limit"):
             assert name in metrics, f"{name} not pre-seeded on /metrics"
 
         # deadline expiry -> 504, structured status, worker reclaimed
@@ -203,6 +213,11 @@ def test_service_lifecycle_deadlines_warm_cache_and_drain():
         # drain: admission closes with Retry-After, in-flight (none) drains
         status, resp, headers = _post(port, "/drain", {})
         assert status == 200
+        # admission closes AFTER the drain response is written (the
+        # cursors-first ordering contract) — wait for that handoff to land
+        deadline = time.monotonic() + 5
+        while not srv._draining and time.monotonic() < deadline:
+            time.sleep(0.01)
         status, resp, headers = _post(port, "/repair", _payload())
         assert status == 503
         assert headers.get("Retry-After") is not None

@@ -152,11 +152,12 @@ def test_collective_allowlist_is_minimal():
 
 def test_fleet_dispatch_routes_through_guarded_helper():
     """Every router->worker HTTP call in observability/fleet.py must live
-    inside FleetRouter._dispatch_once — the ONE dispatch seam (site
+    inside one of the TWO guarded seams: FleetRouter._dispatch_once (site
     ``fleet.dispatch``: chaos-injectable, abort-aware, and the place the
-    eviction/re-dispatch failover keys off). A urlopen added anywhere
-    else in the router would dodge fault injection AND the DispatchFault
-    classification the fleet chaos A/B certifies."""
+    eviction/re-dispatch failover keys off) or FleetAutoscaler._http_once
+    (site ``autoscale.http``: health polls and drain posts). A urlopen
+    added anywhere else in the router would dodge fault injection AND the
+    DispatchFault classification the fleet chaos A/B certifies."""
     import ast
 
     src = (OPS_DIR.parent / "observability" / "fleet.py").read_text()
@@ -164,8 +165,10 @@ def test_fleet_dispatch_routes_through_guarded_helper():
     spans = [(node.lineno, node.end_lineno)
              for node in ast.walk(tree)
              if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-             and node.name == "_dispatch_once"]
-    assert spans, "FleetRouter._dispatch_once disappeared from fleet.py"
+             and node.name in ("_dispatch_once", "_http_once")]
+    assert len(spans) >= 2, ("FleetRouter._dispatch_once or "
+                             "FleetAutoscaler._http_once disappeared "
+                             "from fleet.py")
 
     offenders = []
     for node in ast.walk(tree):
@@ -182,10 +185,13 @@ def test_fleet_dispatch_routes_through_guarded_helper():
         f"(fleet.py lines {offenders}): route it through the guarded "
         "helper so fault injection and eviction/re-dispatch cover it")
 
-    # the seam itself must stay chaos-injectable at its registered site
+    # the seams themselves must stay chaos-injectable at their sites
     assert '_maybe_inject("fleet.dispatch")' in src, (
         "FleetRouter._dispatch_once no longer injects at the "
         "fleet.dispatch site")
+    assert '_maybe_inject("autoscale.http")' in src, (
+        "FleetAutoscaler._http_once no longer injects at the "
+        "autoscale.http site")
 
 
 def test_fleet_and_distinct_sites_are_registered():
