@@ -378,6 +378,9 @@ def smoke() -> int:
     rc = stream_chaos_smoke()
     if rc:
         return rc
+    rc = shard_smoke()
+    if rc:
+        return rc
     return load_smoke()
 
 
@@ -997,6 +1000,9 @@ os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
 os.environ["DELPHI_NUM_PROCESSES"] = "2"
 os.environ["DELPHI_PROCESS_ID"] = rank
 os.environ["DELPHI_MESH"] = "off"
+# keep the replicated-pipeline shard plane out of this A/B too: its merge
+# collectives would add mid-run sync points the chaos plans don't model
+os.environ["DELPHI_SHARD"] = "0"
 import jax
 jax.config.update("jax_platforms", "cpu")
 try:
@@ -1219,6 +1225,489 @@ def dist_chaos() -> int:
     dist_chaos_smoke)."""
     _force_cpu_backend()
     return dist_chaos_smoke()
+
+
+def _shard_frame(n: int = 256):
+    """Deterministic frame for the sharded-pipeline A/B: 32 ``c0`` groups
+    with ``c1``/``c3`` pure functions of the group id (scale-independent
+    domains, so the repair model learns the same mapping at any ``n``) and
+    every 11th row's ``c1`` nulled — the error cells."""
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "tid": [str(i) for i in range(n)],
+        "c0": [f"g{i % 32}" for i in range(n)],
+        "c1": [f"v{(i % 32) % 7}" for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+        "c3": [f"w{(i % 32) % 5}" for i in range(n)],
+    })
+    df.loc[df.index % 11 == 0, "c1"] = None
+    return df
+
+
+# Rank-scoped fault plans for the sharded-pipeline A/B (same grammar as
+# DIST_CHAOS_PLANS). ``parity`` runs clean twice (cold plan build + warm
+# rerun against each rank's persisted per-shard plans); ``death`` kills
+# rank 1 at its first entry into the freq-merge collective, mid-attr-stats,
+# so rank 0's merge watchdog must classify the loss, return the degraded
+# (None) merge, recompute its full range locally and finish bit-identical.
+SHARD_PLANS = {
+    "parity": None,
+    "death": "1:shard.freq.merge:1:rank_death",
+}
+
+# Worker for the 2-process localhost CPU cluster with the replicated-
+# pipeline shard plane armed (DELPHI_SHARD=1): each rank holds the full
+# frame, phase 1-3 analysis splits by row span / owner assignment, and the
+# merge collectives (shard.*.merge) are the only mid-run sync points.
+# DELPHI_MESH=off isolates the A/B to the shard plane. Each rank persists
+# its launch plans into its OWN DELPHI_PLAN_DIR — two ranks read-modify-
+# writing one fingerprint doc concurrently could lose updates — and the
+# warm rerun must land on those per-shard (r<rank>of2-keyed) plans with
+# zero replans.
+_SHARD_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+os.environ.pop("XLA_FLAGS", None)  # one CPU device per process
+rank = sys.argv[1]
+os.environ["DELPHI_COORDINATOR"] = os.environ["COORD"]
+os.environ["DELPHI_NUM_PROCESSES"] = "2"
+os.environ["DELPHI_PROCESS_ID"] = rank
+os.environ["DELPHI_MESH"] = "off"
+os.environ["DELPHI_SHARD"] = "1"
+os.environ["DELPHI_SHARD_MIN_ROWS"] = os.environ.get("SHARD_MIN_ROWS", "64")
+os.environ["DELPHI_PLAN_DIR"] = os.environ["OUT"] + "_plans_r" + rank
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import hashlib
+import pandas as pd
+from delphi_tpu import NullErrorDetector, delphi
+from delphi_tpu import observability as obs
+from delphi_tpu.parallel.distributed import maybe_initialize_distributed
+from delphi_tpu.session import get_session
+
+assert maybe_initialize_distributed()
+assert jax.process_count() == 2
+
+n = int(os.environ.get("N_ROWS", "256"))
+df = pd.DataFrame({
+    "tid": [str(i) for i in range(n)],
+    "c0": ["g" + str(i % 32) for i in range(n)],
+    "c1": ["v" + str((i % 32) % 7) for i in range(n)],
+    "c2": [str((i * 7) % 5) for i in range(n)],
+    "c3": ["w" + str((i % 32) % 5) for i in range(n)],
+})
+df.loc[df.index % 11 == 0, "c1"] = None
+
+PHASES = ("error detection", "attr stats", "cell domain analysis")
+
+
+def phase_walls(span):
+    walls = {}
+
+    def walk(s):
+        if s.get("name") in PHASES:
+            walls[s["name"]] = walls.get(s["name"], 0.0) \
+                + float(s.get("wall_s") or 0.0)
+        for c in s.get("children") or []:
+            walk(c)
+
+    walk(span)
+    return walls
+
+
+runs, frame = [], None
+for run_i in range(int(os.environ.get("SHARD_RUNS", "1"))):
+    # same table name every run: the plan fingerprint derives from it, so
+    # the warm rerun must land on this rank's persisted per-shard plans
+    get_session().register("shard_ab", df.copy())
+    rec = obs.start_recording("bench.shard.r%s.run%d" % (rank, run_i))
+    t0, c0 = time.perf_counter(), time.process_time()
+    try:
+        out = delphi.repair \
+            .setTableName("shard_ab") \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]) \
+            .run()
+    finally:
+        obs.stop_recording(rec)
+        get_session().drop("shard_ab")
+    wall, cpu = time.perf_counter() - t0, time.process_time() - c0
+    counters = rec.registry.snapshot()["counters"]
+    report = obs.build_run_report(rec, run={}, status="ok")
+    runs.append({
+        "wall_s": round(wall, 3),
+        "cpu_s": round(cpu, 3),
+        "phase_wall_s": {k: round(v, 3)
+                         for k, v in phase_walls(report["spans"]).items()},
+        "shard_spans": int(counters.get("shard.spans", 0)),
+        "shard_merges": int(counters.get("shard.merges", 0)),
+        "shard_degraded": int(counters.get("shard.degraded", 0)),
+        "plan_cache_hits": int(counters.get("launch.plan_cache.hits", 0)),
+        "replans": int(counters.get("launch.replans", 0)),
+        "resilience": {k: int(v) for k, v in counters.items()
+                       if k.startswith("resilience.")},
+    })
+    frame = out
+
+frame = frame.sort_values(list(frame.columns)).reset_index(drop=True)
+if os.environ.get("FRAME_HASH_ONLY"):
+    frame_hash = hashlib.sha256(
+        frame.to_csv(index=False).encode()).hexdigest()
+else:
+    frame_hash = None
+    frame.to_json(os.environ["OUT"] + ".frame.r" + rank + ".json",
+                  orient="split")
+with open(os.environ["OUT"] + ".result.r" + rank + ".json", "w") as f:
+    json.dump({"runs": runs, "frame_sha256": frame_hash}, f)
+print("SHARD_WORKER_OK rank=" + rank, flush=True)
+sys.stdout.flush()
+sys.stderr.flush()
+# hard exit: a wedged watchdog thread (or the dead peer's half-closed
+# coordination channel) must not hang interpreter teardown
+os._exit(0)
+"""
+
+
+def _shard_cluster(work: str, scenario: str, plan, n_rows: int = 256,
+                   runs: int = 1, frame_hash_only: bool = False,
+                   timeout_s: int = 900):
+    """Spawn the 2-rank shard worker cluster for one scenario; returns
+    ``(rc0, rc1, out0, out1, results, frames)`` where ``results[r]`` is
+    rank r's parsed result JSON (or None) and ``frames[r]`` its output
+    frame path."""
+    import socket
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(work, "shard_worker.py")
+    if not os.path.exists(worker):
+        with open(worker, "w") as f:
+            f.write(_SHARD_WORKER)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DELPHI_MESH",
+                        "DELPHI_SHARD", "DELPHI_SHARD_MIN_ROWS",
+                        "DELPHI_PLAN_DIR", "DELPHI_PLAN",
+                        "DELPHI_FAULT_PLAN", "DELPHI_METRICS_PORT")}
+    env["COORD"] = f"127.0.0.1:{port}"
+    env["REPO"] = repo
+    env["OUT"] = os.path.join(work, scenario)
+    env["N_ROWS"] = str(n_rows)
+    env["SHARD_RUNS"] = str(runs)
+    if frame_hash_only:
+        env["FRAME_HASH_ONLY"] = "1"
+    if plan:
+        env["DELPHI_FAULT_PLAN"] = plan
+    env["DELPHI_COLLECTIVE_TIMEOUT_S"] = "10"
+    env["DELPHI_HEARTBEAT_S"] = "0.25"
+    env["DELPHI_LIVENESS_DIR"] = os.path.join(work, scenario + "_liveness")
+    if plan:
+        # fault scenarios arm phase checkpoints (rank_loss.json marker);
+        # the clean parity runs must NOT — a warm rerun that short-circuits
+        # through a phase checkpoint never consults the plan store, and the
+        # whole point of run 2 is per-shard plan reuse
+        env["DELPHI_CHECKPOINT_DIR"] = os.path.join(work, scenario + "_ckpt")
+    else:
+        env.pop("DELPHI_CHECKPOINT_DIR", None)
+
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i)], env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    try:
+        out0, _ = procs[0].communicate(timeout=timeout_s)
+        rc0 = procs[0].returncode
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out0, _ = procs[0].communicate()
+        rc0 = None
+    try:
+        out1, _ = procs[1].communicate(timeout=60 if plan else timeout_s)
+        rc1 = procs[1].returncode
+    except subprocess.TimeoutExpired:
+        procs[1].kill()
+        out1, _ = procs[1].communicate()
+        rc1 = None
+
+    results, frames = [], []
+    for r in range(2):
+        path = env["OUT"] + f".result.r{r}.json"
+        try:
+            with open(path) as f:
+                results.append(json.load(f))
+        except (OSError, ValueError):
+            results.append(None)
+        frames.append(env["OUT"] + f".frame.r{r}.json")
+    return rc0, rc1, out0, out1, results, frames
+
+
+def shard_smoke() -> int:
+    """Sharded-pipeline A/B (DELPHI_SHARD): a 2-rank localhost CPU cluster
+    runs the 256-row repair with phase 1-3 analysis row/group-sharded
+    across the ranks, against a clean 1-rank in-process reference.
+
+    ``parity`` (clean, two runs): BOTH ranks' frames must be bit-identical
+    to the 1-rank run, every rank must record shard merges (the exact
+    cross-rank algebra actually fired), the cold run replans, and the warm
+    rerun loads each rank's persisted per-shard plans — plan-cache hits,
+    ZERO replans, on every rank. ``death`` (rank 1 killed at its first
+    freq-merge collective): rank 0 must classify the rank loss, take the
+    degraded merge path (shard.degraded), latch single-host, and still
+    finish with the bit-identical frame. Prints one JSON line; exit code 1
+    on failure."""
+    import tempfile
+
+    import pandas as pd
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.session import get_session
+
+    work = tempfile.mkdtemp(prefix="delphi_shard_")
+
+    # clean single-process reference: shard plane off, mesh off to match
+    # the workers, JSON round-trip for serialization-dtype parity
+    _heartbeat("shard smoke: clean 1-rank reference")
+    saved = {k: os.environ.pop(k, None)
+             for k in ("DELPHI_MESH", "DELPHI_SHARD")}
+    os.environ["DELPHI_MESH"] = "off"
+    os.environ["DELPHI_SHARD"] = "0"
+    get_session().register("shard_ref", _shard_frame())
+    try:
+        ref = delphi.repair \
+            .setTableName("shard_ref") \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]) \
+            .run()
+    finally:
+        get_session().drop("shard_ref")
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ref = ref.sort_values(list(ref.columns)).reset_index(drop=True)
+    ref_path = os.path.join(work, "reference.frame.json")
+    ref.to_json(ref_path, orient="split")
+    ref = pd.read_json(ref_path, orient="split", convert_axes=False,
+                       dtype=False)
+
+    def frame_matches(path) -> bool:
+        try:
+            got = pd.read_json(path, orient="split", convert_axes=False,
+                               dtype=False)
+            pd.testing.assert_frame_equal(got, ref)
+            return True
+        except (OSError, ValueError, AssertionError):
+            return False
+
+    scenarios = {}
+
+    _heartbeat("shard smoke: parity scenario (cold + warm)")
+    rc0, rc1, out0, out1, results, frames = _shard_cluster(
+        work, "parity", SHARD_PLANS["parity"], runs=2)
+    runs = [(r or {}).get("runs") or [{}, {}] for r in results]
+    checks = {
+        "both_ranks_completed": rc0 == 0 and rc1 == 0,
+        "frames_bit_identical": all(frame_matches(p) for p in frames),
+        "spans_on_every_rank": all(
+            r[0].get("shard_spans", 0) > 0 for r in runs),
+        "merges_on_every_rank": all(
+            r[0].get("shard_merges", 0) > 0 for r in runs),
+        "nothing_degraded": all(
+            run.get("shard_degraded", 0) == 0 for r in runs for run in r),
+        "cold_replans": all(r[0].get("replans", 0) > 0 for r in runs),
+        "warm_zero_replans_per_rank": all(
+            len(r) > 1 and r[1].get("replans", -1) == 0 for r in runs),
+        "warm_plan_hits_per_rank": all(
+            len(r) > 1 and r[1].get("plan_cache_hits", 0) > 0 for r in runs),
+    }
+    scenarios["parity"] = {"rc0": rc0, "rc1": rc1, "checks": checks,
+                           "runs": runs}
+    if not all(checks.values()):
+        print(f"shard parity worker tails:\n--- rank 0 (rc={rc0}) ---\n"
+              f"{out0[-2000:]}\n--- rank 1 (rc={rc1}) ---\n{out1[-2000:]}",
+              file=sys.stderr)
+
+    _heartbeat(f"shard smoke: death scenario ({SHARD_PLANS['death']})")
+    rc0, rc1, out0, out1, results, frames = _shard_cluster(
+        work, "death", SHARD_PLANS["death"], runs=1)
+    run0 = ((results[0] or {}).get("runs") or [{}])[0]
+    res = run0.get("resilience", {})
+    checks = {
+        "survivor_completed": rc0 == 0,
+        "peer_died_hard": rc1 == 17,
+        "frame_bit_identical": frame_matches(frames[0]),
+        "rank_loss_counted": res.get("resilience.dist.rank_loss", 0) >= 1,
+        "merge_degraded": run0.get("shard_degraded", 0) >= 1,
+        "single_host_latched":
+            res.get("resilience.dist.single_host_latch", 0) >= 1,
+        "loss_marker_written": os.path.exists(
+            os.path.join(work, "death_ckpt", "rank_loss.json")),
+    }
+    scenarios["death"] = {"plan": SHARD_PLANS["death"], "rc0": rc0,
+                          "rc1": rc1, "checks": checks, "run": run0}
+    if not all(checks.values()):
+        print(f"shard death worker tails:\n--- rank 0 (rc={rc0}) ---\n"
+              f"{out0[-2000:]}\n--- rank 1 (rc={rc1}) ---\n{out1[-2000:]}",
+              file=sys.stderr)
+
+    ok = all(all(s["checks"].values()) for s in scenarios.values())
+    merges = sum(run.get("shard_merges", 0)
+                 for r in scenarios["parity"]["runs"] for run in r)
+    print(json.dumps({
+        "metric": "shard_smoke", "value": merges,
+        "unit": "shard merges", "vs_baseline": None, "ok": ok,
+        "scenarios": scenarios,
+    }), flush=True)
+    if not ok:
+        failed = {name: [c for c, v in s["checks"].items() if not v]
+                  for name, s in scenarios.items()
+                  if not all(s["checks"].values())}
+        print("shard smoke FAILED: the sharded pipeline must stay "
+              f"bit-identical, reuse per-shard plans warm, and degrade "
+              f"exactly on rank loss (failed checks: {failed})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def shard() -> int:
+    """Standalone `bench.py --shard-smoke` entry: 2-rank localhost CPU
+    cluster, sharded phase 1-3 parity + warm-plan + rank-death A/B (see
+    shard_smoke)."""
+    _force_cpu_backend()
+    return shard_smoke()
+
+
+def shard_bench() -> int:
+    """`bench.py --shard` series: 100k- and 1M-row repairs, 1-rank
+    in-process vs a 2-rank shard-plane cluster, landing
+    ``BENCH_SHARD_r01.json`` with per-phase walls, per-rank CPU time and
+    frame-hash parity. On a single-core container the 2-rank WALL cannot
+    beat 1-rank (both ranks timeshare one core) — the artifact records the
+    honest walls plus the per-rank CPU split as the scaling evidence."""
+    import hashlib
+    import tempfile
+    import time
+
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu import observability as obs
+    from delphi_tpu.session import get_session
+
+    _force_cpu_backend()
+    work = tempfile.mkdtemp(prefix="delphi_shard_bench_")
+    cores = os.cpu_count() or 1
+
+    phases = ("error detection", "attr stats", "cell domain analysis")
+
+    def walls_of(span, acc):
+        if span.get("name") in phases:
+            acc[span["name"]] = acc.get(span["name"], 0.0) \
+                + float(span.get("wall_s") or 0.0)
+        for c in span.get("children") or []:
+            walls_of(c, acc)
+        return acc
+
+    def one_rank(n_rows: int) -> dict:
+        _heartbeat(f"shard bench: 1-rank n={n_rows}")
+        saved = {k: os.environ.pop(k, None)
+                 for k in ("DELPHI_MESH", "DELPHI_SHARD")}
+        os.environ["DELPHI_MESH"] = "off"
+        os.environ["DELPHI_SHARD"] = "0"
+        get_session().register("shard_bench", _shard_frame(n_rows))
+        rec = obs.start_recording(f"bench.shard.1rank.{n_rows}")
+        t0, c0 = time.perf_counter(), time.process_time()
+        try:
+            out = delphi.repair \
+                .setTableName("shard_bench") \
+                .setRowId("tid") \
+                .setErrorDetectors([NullErrorDetector()]) \
+                .run()
+        finally:
+            obs.stop_recording(rec)
+            get_session().drop("shard_bench")
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        wall, cpu = time.perf_counter() - t0, time.process_time() - c0
+        report = obs.build_run_report(rec, run={}, status="ok")
+        frame = out.sort_values(list(out.columns)).reset_index(drop=True)
+        return {
+            "wall_s": round(wall, 3), "cpu_s": round(cpu, 3),
+            "phase_wall_s": {k: round(v, 3)
+                             for k, v in walls_of(report["spans"],
+                                                  {}).items()},
+            "frame_sha256": hashlib.sha256(
+                frame.to_csv(index=False).encode()).hexdigest(),
+        }
+
+    series = []
+    for n_rows in (100_000, 1_000_000):
+        single = one_rank(n_rows)
+        _heartbeat(f"shard bench: 2-rank n={n_rows}")
+        rc0, rc1, out0, out1, results, _ = _shard_cluster(
+            work, f"bench{n_rows}", None, n_rows=n_rows, runs=1,
+            frame_hash_only=True, timeout_s=3600)
+        if rc0 != 0 or rc1 != 0:
+            print(f"shard bench n={n_rows} cluster failed "
+                  f"(rc0={rc0} rc1={rc1}):\n{out0[-2000:]}\n{out1[-2000:]}",
+                  file=sys.stderr)
+            return 1
+        ranks = [(r or {}) for r in results]
+        runs = [(r.get("runs") or [{}])[0] for r in ranks]
+        entry = {
+            "n_rows": n_rows,
+            "one_rank": single,
+            "two_rank": {
+                "wall_s": max(r.get("wall_s", 0.0) for r in runs),
+                "per_rank": [
+                    {"wall_s": r.get("wall_s"), "cpu_s": r.get("cpu_s"),
+                     "phase_wall_s": r.get("phase_wall_s", {}),
+                     "shard_merges": r.get("shard_merges"),
+                     "shard_spans": r.get("shard_spans")}
+                    for r in runs],
+                "frame_sha256": [r.get("frame_sha256") for r in ranks],
+            },
+            "frame_bit_identical": all(
+                r.get("frame_sha256") == single["frame_sha256"]
+                for r in ranks),
+        }
+        series.append(entry)
+        print(json.dumps({"progress": entry}), flush=True)
+
+    ok = all(e["frame_bit_identical"] for e in series)
+    result = {
+        "metric": "shard_bench",
+        "value": sum(int(e["frame_bit_identical"]) for e in series),
+        "unit": "scales bit-identical", "vs_baseline": None, "ok": ok,
+        "cpu_cores": cores,
+        "note": (
+            "single-core container: both ranks timeshare one CPU, so the "
+            "2-rank WALL cannot beat 1-rank here and per-rank phase walls "
+            "inflate with scheduler interleaving; the split itself is "
+            "evidenced by shard_spans/shard_merges (each rank computed "
+            "only its half-span and the merged frames stay bit-identical "
+            "at every scale) — on real multi-host TPU/CPU the same split "
+            "is the wall speedup"
+        ) if cores == 1 else None,
+        "series": series,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SHARD_r01.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+    return 0 if ok else 1
 
 
 def _incremental_frames(n: int = 64):
@@ -3557,6 +4046,24 @@ def main() -> None:
                              "worker kill keeps accounting exact, and a "
                              "degraded baseline trips the slo drift gate; "
                              "exits 1 on failure")
+    parser.add_argument("--shard-smoke", dest="shard_smoke",
+                        action="store_true",
+                        help="sharded-pipeline A/B on the CPU backend: a "
+                             "2-rank localhost cluster repairs the smoke "
+                             "frame with phase 1-3 analysis row/group-"
+                             "sharded (DELPHI_SHARD=1), asserting frames "
+                             "bit-identical to a 1-rank run on both ranks, "
+                             "warm reruns loading each rank's persisted "
+                             "per-shard plans with zero replans, and a "
+                             "rank killed mid-attr-stats degrading to the "
+                             "local-recompute path with the frame still "
+                             "bit-identical; exits 1 on failure")
+    parser.add_argument("--shard", dest="shard", action="store_true",
+                        help="sharded-pipeline series: 100k- and 1M-row "
+                             "repairs, 1-rank vs a 2-rank DELPHI_SHARD "
+                             "cluster, landing BENCH_SHARD_r01.json with "
+                             "per-phase walls, per-rank CPU time and "
+                             "frame-hash parity; exits 1 on failure")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -3609,6 +4116,12 @@ def main() -> None:
 
     if args.load_smoke:
         sys.exit(load_smoke_entry())
+
+    if args.shard_smoke:
+        sys.exit(shard())
+
+    if args.shard:
+        sys.exit(shard_bench())
 
     if args._child:
         _child_main(args)

@@ -2639,6 +2639,21 @@ class RepairModel:
         # (the A/B toggle is DELPHI_DEVICE_TABLE, see ops/xfer.py).
         from delphi_tpu.ops import xfer
         gauge_set("device_table.enabled", int(xfer.device_table_enabled()))
+        # Replicated-pipeline shard plane (DELPHI_SHARD): stamp the rank/
+        # world topology and this rank's row span into the run report so
+        # the per-phase spans of a 2-rank A/B are attributable — and so a
+        # mid-run degrade (shard.world present but shard.degraded counted)
+        # is visible at a glance.
+        from delphi_tpu.parallel import rowshard
+        if rowshard.shard_enabled():
+            s_rank, s_world = rowshard.world()
+            gauge_set("shard.world", s_world)
+            gauge_set("shard.rank", s_rank)
+            span = rowshard.active_span(table.n_rows)
+            run_info["shard"] = {
+                "rank": s_rank, "world": s_world,
+                "rows": [int(span[0]), int(span[1])] if span else None,
+            }
         run_info.update({
             "input_table": input_name,
             "n_rows": int(table.n_rows),

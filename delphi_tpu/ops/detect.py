@@ -38,6 +38,16 @@ CellIndex = Tuple[np.ndarray, str]  # (row indices, attribute)
 
 def detect_null_cells(table: EncodedTable, target_attrs: Sequence[str]) \
         -> List[CellIndex]:
+    from delphi_tpu.parallel import rowshard
+
+    span = None if getattr(table, "process_local", False) \
+        else rowshard.active_span(table.n_rows)
+    if span is not None:
+        out = _detect_null_cells_sharded(table, target_attrs, span)
+        if out is not None:
+            return out
+        # degraded merge (rank lost mid-phase): fall through to the exact
+        # full-table scan below — same bytes, just not parallel
     # rows this detection pass actually walked — the incremental A/B's
     # proof that a delta run detected over only the planned row subset
     counter_inc("detect.rows_scanned", table.n_rows)
@@ -49,6 +59,37 @@ def detect_null_cells(table: EncodedTable, target_attrs: Sequence[str]) \
             if rows.size:
                 counter_inc("detect.null_cells", rows.size)
                 out.append((rows, name))
+    return out
+
+
+def _detect_null_cells_sharded(table: EncodedTable,
+                               target_attrs: Sequence[str],
+                               span) -> Optional[List[CellIndex]]:
+    """Row-sharded NULL scan (DELPHI_SHARD): each rank scans only its
+    contiguous span, per-column absolute row indices gather through the
+    guarded ``shard.detect.merge`` collective and concatenate in rank
+    order — which IS ascending row order for contiguous spans, so the
+    result is bit-identical to the full scan. ``None`` on a degraded
+    gather (caller rescans the full table locally)."""
+    from delphi_tpu.parallel import rowshard
+
+    lo, hi = span
+    counter_inc("detect.rows_scanned", hi - lo)
+    local = []
+    names = [n for n in table.column_names if n in target_attrs]
+    for name in names:
+        counter_inc("detect.cells_scanned", hi - lo)
+        rows = np.nonzero(table.column(name).null_mask()[lo:hi])[0]
+        local.append((rows + lo).astype(rows.dtype) if rows.size else rows)
+    parts = rowshard.merge_parts(local, site="shard.detect.merge")
+    if parts is None:
+        return None
+    out: List[CellIndex] = []
+    for i, name in enumerate(names):
+        rows = np.concatenate([np.asarray(p[i]) for p in parts])
+        if rows.size:
+            counter_inc("detect.null_cells", rows.size)
+            out.append((rows, name))
     return out
 
 

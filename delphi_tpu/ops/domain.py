@@ -290,6 +290,53 @@ def compute_weak_label_mask(
         vocab_rank[order] = np.arange(len(vocab))
         ranks[gi] = (vocab_str, vocab_rank)
 
+    # Replicated-pipeline sharding (DELPHI_SHARD, parallel/rowshard.py):
+    # the work splits by WHOLE groups — group row-counts gate the fused-
+    # kernel route (>= 65536), so group-level splitting keeps every group's
+    # launch shapes, routes and float semantics identical to the single-
+    # process run, and the disjoint per-group demote partials OR together
+    # bit-identically. Ledger runs stay unsharded: per-cell provenance
+    # must observe every group on this process.
+    from delphi_tpu.parallel import rowshard
+    owners = None
+    if led is None and mesh is None \
+            and not getattr(table, "process_local", False) \
+            and rowshard.shard_enabled() and len(groups) > 1:
+        owners = rowshard.assign_owners(
+            [0 if g.empty_domain else len(g.rows) for g in groups])
+    if owners is None:
+        gis = list(range(len(groups)))
+    else:
+        my_rank = rowshard.world()[0]
+        gis = [gi for gi, g in enumerate(groups)
+               if owners[gi] == my_rank or g.empty_domain]
+    _weak_label_groups(table, groups, ranks, gis, demote, led, mesh, beta)
+    if owners is not None:
+        parts = rowshard.merge_parts(
+            np.packbits(demote), site="shard.domain.weak")
+        if parts is not None:
+            merged = np.zeros(len(np.packbits(demote)), dtype=np.uint8)
+            for p in parts:
+                merged |= np.asarray(p, dtype=np.uint8)
+            demote = np.unpackbits(
+                merged, count=len(demote)).astype(bool)
+        else:
+            # degraded merge (rank lost mid-phase): score the groups the
+            # peers owned — locally and exactly — and finish alone
+            done = set(gis)
+            rest = [gi for gi in range(len(groups)) if gi not in done]
+            _weak_label_groups(table, groups, ranks, rest, demote, led,
+                               mesh, beta)
+    return demote
+
+
+def _weak_label_groups(table, groups, ranks, gis, demote, led, mesh, beta):
+    """Scores + weak-labels the groups named by ``gis`` (indices into
+    ``groups``), writing demotions in place — the per-group body of
+    :func:`compute_weak_label_mask`, callable over a subset so the shard
+    plane can run only the groups this rank owns (and the degraded path
+    can finish the rest). Every route is per-group independent, so the
+    subset split cannot change any group's bytes."""
     # Device-resident default: int32-safe groups go through the bucketed
     # batched launcher. The fused mode (per-cell scalars only, same gate as
     # the legacy fused route: no ledger, big-or-forced) and the integer mode
@@ -297,7 +344,8 @@ def compute_weak_label_mask(
     plan: Dict[int, str] = {}
     if _bucketed_enabled(table):
         jobs = []
-        for gi, group in enumerate(groups):
+        for gi in gis:
+            group = groups[gi]
             if group.empty_domain or not _int32_safe_group(group):
                 continue
             g_fused = led is None \
@@ -312,7 +360,8 @@ def compute_weak_label_mask(
     else:
         bucket_results = {}
 
-    for gi, group in enumerate(groups):
+    for gi in gis:
+        group = groups[gi]
         if group.empty_domain:
             if led is not None and len(group.rows):
                 led.record_domain_sizes(

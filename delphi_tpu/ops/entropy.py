@@ -100,23 +100,57 @@ def compute_pairwise_stats(
             seen.add(key)
             uniq.append((x, y))
     mats = [freq.pair(x, y).ravel() for x, y in uniq]
+
+    # Replicated-pipeline sharding (DELPHI_SHARD): every rank holds the
+    # identical replicated pair matrices, so the pair LIST splits by a
+    # deterministic greedy owner assignment (weighted by matrix size) and
+    # each rank reduces only its own pairs; the scalar H(x,y) values merge
+    # through one guarded gather. Each entropy is an independent float64
+    # reduction over one matrix — per-pair results are bit-identical to
+    # the single-process loop regardless of who computed them. A degraded
+    # merge computes the missing pairs locally. H(y) stays replicated
+    # (one vector per attribute — cheaper than a collective).
+    from delphi_tpu.parallel import rowshard
+    owners = None
+    if len(uniq) > 1 and rowshard.shard_enabled():
+        owners = rowshard.assign_owners([int(m.size) for m in mats])
+    rank = rowshard.world()[0] if owners is not None else 0
+    mine = [i for i in range(len(uniq))
+            if owners is None or owners[i] == rank]
+
     plan = planner.plan_launches(
         "entropy",
         [planner.Piece(
-            key=i, size=int(m.size),
-            shape=("pallas" if _use_pallas_entropy(m.size, n_rows)
+            key=i, size=int(mats[i].size),
+            shape=("pallas" if _use_pallas_entropy(mats[i].size, n_rows)
                    else "host",))
-         for i, m in enumerate(mats)],
+         for i in mine],
         persist=False)
     plan.record()
-    h_xy: Dict[frozenset, float] = {}
+    h_local: Dict[int, float] = {}
     for launch in plan.launches:
         with plan.launch_scope(launch):
             for span in launch.spans:
                 x, y = uniq[span.key]
-                h_xy[frozenset((x, y))] = _entropy_with_correction(
+                h_local[span.key] = _entropy_with_correction(
                     mats[span.key], n_rows,
                     int(domain_stats[x]) * int(domain_stats[y]))
+
+    if owners is not None:
+        parts = rowshard.merge_parts(h_local, site="shard.entropy.merge")
+        if parts is not None:
+            for p in parts:
+                h_local.update(p)
+        # degraded (or a peer's dict missing entries): compute whatever is
+        # still absent locally — exact, just not parallel
+        for i in range(len(uniq)):
+            if i not in h_local:
+                x, y = uniq[i]
+                h_local[i] = _entropy_with_correction(
+                    mats[i], n_rows,
+                    int(domain_stats[x]) * int(domain_stats[y]))
+    h_xy: Dict[frozenset, float] = {
+        frozenset(uniq[i]): h for i, h in h_local.items()}
 
     # H(y) per attr
     h_y: Dict[str, float] = {}

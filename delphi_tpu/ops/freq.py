@@ -224,6 +224,17 @@ def compute_freq_stats(table: EncodedTable,
             singles=singles, pairs=pair_mats,
             threshold_count=int(table.n_rows * attr_freq_ratio_threshold))
 
+    # Replicated-pipeline sharding (DELPHI_SHARD, parallel/rowshard.py):
+    # every rank holds the full table but counts only its contiguous row
+    # span; the per-shard count arrays sum exactly across ranks through
+    # ONE guarded byte-gather at the end of the phase. Count sums are
+    # exact integer algebra, so the merged FreqStats is bit-identical to
+    # the single-process computation. A degraded merge (rank lost
+    # mid-phase) recomputes the full range locally via a recursive call —
+    # active_span is None once single-host latches.
+    from delphi_tpu.parallel import rowshard
+    shard_span = rowshard.active_span(table.n_rows)
+
     # Single-device path: with the device-resident table plane on (the
     # default), each needed column uploads ONCE through the cached seam and
     # the [n, m] working matrix is a device-side stack — later phases
@@ -233,9 +244,15 @@ def compute_freq_stats(table: EncodedTable,
     from delphi_tpu.ops import xfer
     if xfer.device_table_enabled():
         codes = jnp.stack(
-            [xfer.device_codes(table.column(a)) for a in needed], axis=1)
+            [xfer.device_codes(table.column(a), span=shard_span)
+             for a in needed], axis=1)
+    elif shard_span is not None:
+        codes = xfer.to_device(
+            table.codes(needed)[shard_span[0]:shard_span[1]])
     else:
         codes = xfer.to_device(table.codes(needed))
+    n_local = int(table.n_rows) if shard_span is None \
+        else shard_span[1] - shard_span[0]
     from delphi_tpu.parallel.resilience import run_guarded
     singles_arr = np.asarray(run_guarded(
         "freq.singles", lambda: _batched_single_counts(codes, v_pad)))
@@ -268,10 +285,12 @@ def compute_freq_stats(table: EncodedTable,
         # from the unified planner (DELPHI_PAIR_BUDGET is the cap knob).
         from delphi_tpu.parallel import planner
         per_launch = max(1,
-                         int(_pair_keys_per_launch() // max(table.n_rows, 1)))
+                         int(_pair_keys_per_launch() // max(n_local, 1)))
+        # piece shapes carry the SHARD extent (n_local) so per-shard plans
+        # are keyed by what this rank actually launches
         pair_plan = planner.plan_launches(
             "freq.pairs",
-            [planner.Piece(key=i, size=1, shape=(v_pad, int(table.n_rows)))
+            [planner.Piece(key=i, size=1, shape=(v_pad, n_local))
              for i in range(len(xla_pairs))],
             batch_cap=per_launch, persist=False)
         pair_plan.record()
@@ -291,6 +310,15 @@ def compute_freq_stats(table: EncodedTable,
                     pair_mats[(x, y)] = \
                         m[: vocab_sizes[x] + 1, : vocab_sizes[y] + 1]
 
+    if shard_span is not None:
+        merged = _merge_shard_counts(singles, pair_mats)
+        if merged is None:
+            # degraded mid-merge: the shard plane latched single-host, so
+            # this recursive call takes the exact legacy full-table path
+            return compute_freq_stats(table, target_attrs, pair_attrs,
+                                      attr_freq_ratio_threshold)
+        singles, pair_mats = merged
+
     return FreqStats(
         n_rows=table.n_rows,
         attrs=attrs,
@@ -299,6 +327,33 @@ def compute_freq_stats(table: EncodedTable,
         pairs=pair_mats,
         threshold_count=int(table.n_rows * attr_freq_ratio_threshold),
     )
+
+
+def _merge_shard_counts(singles: Dict[str, np.ndarray],
+                        pair_mats: Dict[Pair, np.ndarray]):
+    """EXACT cross-rank merge of per-shard freq counts (DELPHI_SHARD): one
+    guarded byte-gather (site ``shard.freq.merge``) of every rank's
+    singleton vectors and pair matrices, summed in int64 and cast back to
+    the kernel dtype — counts are bounded by n_rows, so the cast is
+    lossless and the result matches the single-process bincount bit for
+    bit. ``None`` on a degraded gather."""
+    from delphi_tpu.parallel import rowshard
+
+    parts = rowshard.merge_parts((singles, pair_mats),
+                                 site="shard.freq.merge")
+    if parts is None:
+        return None
+    out_singles: Dict[str, np.ndarray] = {}
+    for a, arr in singles.items():
+        total = np.sum([np.asarray(p[0][a], dtype=np.int64) for p in parts],
+                       axis=0)
+        out_singles[a] = total.astype(arr.dtype)
+    out_pairs: Dict[Pair, np.ndarray] = {}
+    for key, m in pair_mats.items():
+        total = np.sum([np.asarray(p[1][key], dtype=np.int64)
+                        for p in parts], axis=0)
+        out_pairs[key] = total.astype(m.dtype)
+    return out_singles, out_pairs
 
 
 @jax.jit
@@ -435,8 +490,18 @@ class PairDistinctCounter:
                 merged = self._merge_global_exact(
                     [self._host_distinct_pair_keys(x, y) for x, y in todo])
             else:
-                merged = [self._host_distinct_pair_count(x, y)
-                          for x, y in todo]
+                merged = None
+                from delphi_tpu.parallel import rowshard
+                span = rowshard.active_span(self._table.n_rows)
+                if span is not None:
+                    # DELPHI_SHARD: each rank dedups only its row span's
+                    # fused keys, the per-pair key SETS union across ranks
+                    # (the PR-12 exact-merge algebra over row spans of one
+                    # replicated table) — bit-identical counts
+                    merged = self._merge_shard_exact(todo, span)
+                if merged is None:
+                    merged = [self._host_distinct_pair_count(x, y)
+                              for x, y in todo]
             for (x, y), c in zip(todo, merged):
                 self._cache[frozenset((x, y))] = c
             return
@@ -487,11 +552,33 @@ class PairDistinctCounter:
         for (x, y), c in zip(todo, local_counts):
             self._cache[frozenset((x, y))] = c
 
-    def _fused_pair_keys(self, x: str, y: str) -> np.ndarray:
+    def _merge_shard_exact(self, todo, span):
+        """EXACT distinct-pair counts over the replicated table's row
+        shards (DELPHI_SHARD): this rank's deduped fused keys per pair over
+        ``[lo, hi)`` gather through the guarded ``shard.distinct.merge``
+        collective, then union per pair — the same algebra as
+        :meth:`_merge_global_exact`, just with spans of one replicated
+        table instead of process-local shards. ``None`` when the gather
+        degrades (the caller recounts the full range locally)."""
+        from delphi_tpu.parallel import rowshard
+
+        lo, hi = span
+        keys_list = [np.unique(self._fused_pair_keys(x, y, lo, hi))
+                     for x, y in todo]
+        parts = rowshard.merge_parts(keys_list, site="shard.distinct.merge")
+        if parts is None or any(len(p) != len(todo) for p in parts):
+            return None
+        return [int(len(np.unique(np.concatenate(
+                    [np.asarray(p[i], dtype=np.int64) for p in parts]))))
+                for i in range(len(todo))]
+
+    def _fused_pair_keys(self, x: str, y: str,
+                         lo: int = 0, hi: Optional[int] = None) -> np.ndarray:
         cx = self._table.column(x)
         cy = self._table.column(y)
-        return (cx.codes.astype(np.int64) + 1) * (cy.domain_size + 1) \
-            + (cy.codes.astype(np.int64) + 1)
+        sl = slice(lo, hi)
+        return (cx.codes[sl].astype(np.int64) + 1) * (cy.domain_size + 1) \
+            + (cy.codes[sl].astype(np.int64) + 1)
 
     def _host_distinct_pair_keys(self, x: str, y: str) -> np.ndarray:
         """This shard's DEDUPED fused (x, y) keys — the exact-merge wire

@@ -45,6 +45,12 @@ _DEVICE_CODES_ATTR = "_delphi_device_codes"
 # goes through dataclasses.replace with a NEW codes array — so memoizing
 # on the object is safe and makes repeat lookups O(1).
 _CODES_FP_ATTR = "_delphi_codes_fp"
+# Span-sliced variant of the device-codes slot, used by the replicated-
+# pipeline shard plane (parallel/rowshard.py): holds ((lo, hi), buffer) for
+# the ONE row span this rank owns, so shard-phase kernels re-serve the
+# sliced upload without touching the full-table buffer. Same invalidation
+# story as _DEVICE_CODES_ATTR — dataclasses.replace drops it.
+_DEVICE_SHARD_ATTR = "_delphi_device_shard"
 
 _PHASE_SAN = re.compile(r"[^A-Za-z0-9_.-]+")
 
@@ -138,7 +144,7 @@ def codes_fingerprint(col) -> str:
     return fp
 
 
-def device_codes(col):
+def device_codes(col, span=None):
     """Device-resident int32 codes for one :class:`~delphi_tpu.table.
     EncodedColumn` — uploaded once per column CONTENT, then served from
     cache (``transfer.reuses`` counts every hit). Lookup is two-level: the
@@ -147,7 +153,22 @@ def device_codes(col):
     (``transfer.content_hits`` counts those), so a rebuilt table whose
     column bytes didn't change still reuses the device buffer. With the
     plane disabled (``DELPHI_DEVICE_TABLE=0``) every call re-uploads, which
-    is the legacy behavior the transfer ledger benchmarks against."""
+    is the legacy behavior the transfer ledger benchmarks against.
+
+    With ``span=(lo, hi)`` (the shard plane's row span) only that slice
+    uploads, cached in its own per-object slot: a rank never pays device
+    memory or transfer bytes for rows it doesn't own."""
+    if span is not None:
+        lo, hi = int(span[0]), int(span[1])
+        if not device_table_enabled():
+            return to_device(np.ascontiguousarray(col.codes[lo:hi]))
+        cached = getattr(col, _DEVICE_SHARD_ATTR, None)
+        if cached is not None and cached[0] == (lo, hi):
+            counter_inc("transfer.reuses")
+            return cached[1]
+        arr = to_device(np.ascontiguousarray(col.codes[lo:hi]))
+        setattr(col, _DEVICE_SHARD_ATTR, ((lo, hi), arr))
+        return arr
     if not device_table_enabled():
         return to_device(col.codes)
     cached = getattr(col, _DEVICE_CODES_ATTR, None)
@@ -191,6 +212,12 @@ def evict_device_codes(cols) -> int:
         if getattr(col, _DEVICE_CODES_ATTR, None) is not None:
             try:
                 delattr(col, _DEVICE_CODES_ATTR)
+                n += 1
+            except AttributeError:  # pragma: no cover - concurrent evict
+                pass
+        if getattr(col, _DEVICE_SHARD_ATTR, None) is not None:
+            try:
+                delattr(col, _DEVICE_SHARD_ATTR)
                 n += 1
             except AttributeError:  # pragma: no cover - concurrent evict
                 pass

@@ -129,6 +129,23 @@ def allgather_host_bytes(payload: bytes,
     return guarded_collective(site, _gather, fallback=lambda: [payload])
 
 
+def allgather_bytes_or_none(payload: bytes, site: str):
+    """:func:`allgather_host_bytes`, but a degraded gather (fewer payloads
+    than live processes — a peer was declared lost mid-collective) returns
+    ``None`` instead of silently shrinking to the local payload. The
+    replicated-pipeline shard merges (parallel/rowshard.py) need the
+    distinction: a partial merge of per-shard phase outputs would be a
+    silently-wrong lower bound, so on ``None`` the caller recomputes its
+    full range locally — exact, just not parallel."""
+    world = process_count()
+    if world == 1:
+        return [payload]
+    gathered = allgather_host_bytes(payload, site=site)
+    if len(gathered) != world:
+        return None
+    return gathered
+
+
 def allgather_sum(arr):
     """Elementwise sum of a small numeric array across processes (global
     counts from per-shard counts). Identity when single-process or after

@@ -411,7 +411,10 @@ def stored_launch_shapes(fingerprint: Optional[str],
     doc_phases = store._doc(fingerprint).get("phases", {})
     out: List[Tuple[Shape, int, int]] = []
     for name, payload in sorted(doc_phases.items()):
-        if name != phase and not name.startswith(phase + "["):
+        # `phase[i]` = per-work-group plans; `phase@r<k>of<w>` = the shard
+        # plane's per-rank plans (parallel/rowshard.py)
+        if name != phase and not name.startswith(phase + "[") \
+                and not name.startswith(phase + "@"):
             continue
         out.extend((tuple(l["shape"]), int(l["padded"]), int(l["batch_pad"]))
                    for l in payload.get("launches", []))
@@ -483,12 +486,23 @@ def plan_launches(
             # store — and the default signature is byte-identical to the
             # pre-ledger planner.
             policy["cost"] = True
+    from delphi_tpu.parallel import rowshard
+    shard_tag = rowshard.plan_shard_tag()
+    if shard_tag:
+        # replicated-pipeline sharding (DELPHI_SHARD): the rank tag rides
+        # in the signature AND the store phase key, so each rank persists
+        # its OWN per-shard plan (the shard extent is already in the piece
+        # shapes the sharded phases pass) — a warm rerun replans zero
+        # times on every rank. Absent when off: legacy signatures and
+        # store slots stay byte-identical.
+        policy["shard"] = shard_tag
     sig = _signature(phase, pieces, policy)
 
+    store_phase = f"{phase}@{shard_tag}" if shard_tag else phase
     fp = fingerprint if fingerprint is not None else current_fingerprint()
     store = get_plan_store() if (persist and enabled) else None
     if store is not None and fp:
-        stored = store.load(fp, phase)
+        stored = store.load(fp, store_phase)
         if stored and stored.get("signature") == sig:
             counter_inc("launch.plan_cache.hits")
             return LaunchPlan.from_payload(phase, stored)
@@ -498,7 +512,7 @@ def plan_launches(
 
     if store is not None and fp:
         counter_inc("launch.replans")
-        store.save(fp, phase, plan.to_payload())
+        store.save(fp, store_phase, plan.to_payload())
     return plan
 
 

@@ -366,6 +366,14 @@ KNOWN_SITES = frozenset({
     "dist.allgather_any",
     "dist.allgather_max",
     "report.gather",
+    # replicated-pipeline shard merges (parallel/rowshard.py): every
+    # cross-rank phase merge of the DELPHI_SHARD plane — rank-scoped
+    # stall/rank_death plans here rehearse a peer dying mid-phase
+    "shard.detect.merge",
+    "shard.freq.merge",
+    "shard.distinct.merge",
+    "shard.entropy.merge",
+    "shard.domain.weak",
     # durable-store seam sites (parallel/store.py STORE_SITES): every
     # artifact write passes the injection point, so torn_write/crash plan
     # entries rehearse kill-mid-write at each store

@@ -1,0 +1,155 @@
+"""Row/group sharding of the REPLICATED repair pipeline (``DELPHI_SHARD``).
+
+Three cross-process modes now coexist and must not be confused:
+
+* **process-local tables** (sharded ingestion): each process holds ONLY its
+  rows and the whole pipeline runs off the shards — see
+  :mod:`delphi_tpu.parallel.sharded` and docs/source/scaling.rst.
+* **the device mesh** (``DELPHI_MESH``): row-sharding across local devices
+  inside one process.
+* **THIS plane** (``DELPHI_SHARD=1`` on a multi-process cluster): every
+  process holds the FULL table — the normal replicated batch path — and
+  phase 1–3 analysis work (NULL detection scans, freq/pair counting,
+  distinct-pair pruning, conditional entropy, weak-label domain scoring)
+  splits across the process mesh by contiguous row span or by whole work
+  groups. Partial results merge through the guarded collectives in
+  :mod:`delphi_tpu.parallel.distributed` with EXACT algebra only —
+  integer count sums, fused-key set unions, disjoint-group ORs — so the
+  merged arrays are bit-identical to the single-process computation,
+  never an approximation or a lower bound.
+
+Degradation contract (the dist-resilience taxonomy): every merge helper
+returns ``None`` when the gather came back degraded — a peer was declared
+lost (``resilience.dist.rank_loss``) and the collective plane latched
+single-host. The call site then recomputes the FULL range locally — still
+exact — and :func:`shard_enabled` reads False for every later phase
+(``single_host_latched``), so one rank loss costs at most one phase's
+worth of local recompute and the run completes with the same bytes it
+would have produced alone.
+
+Determinism: all ranks hold identical replicated inputs, so span math,
+greedy owner assignment and the per-phase merge sequence are identical
+everywhere — collectives always line up across ranks.
+"""
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from delphi_tpu.observability import counter_inc
+
+_FALSY = frozenset({"", "0", "false", "no", "off"})
+
+# Below this many rows the merge round-trips cost more than the split
+# saves; the whole table stays on every rank (exactly the legacy path).
+_DEFAULT_MIN_ROWS = 4096
+
+
+def shard_min_rows() -> int:
+    """Row floor under which sharding stays off (``DELPHI_SHARD_MIN_ROWS``,
+    default 4096)."""
+    try:
+        return int(os.environ.get("DELPHI_SHARD_MIN_ROWS", "")
+                   or _DEFAULT_MIN_ROWS)
+    except ValueError:
+        return _DEFAULT_MIN_ROWS
+
+
+def shard_enabled() -> bool:
+    """True when the replicated-pipeline shard plane is live: opted in
+    (``DELPHI_SHARD`` truthy — OFF by default, so single-process runs and
+    the process-local/mesh modes are byte-for-byte untouched), more than
+    one process in the cluster, and the collective plane not degraded to
+    single-host by a rank loss."""
+    if os.environ.get("DELPHI_SHARD", "").strip().lower() in _FALSY:
+        return False
+    from delphi_tpu.parallel import dist_resilience as dr
+    if dr.single_host_latched():
+        return False
+    from delphi_tpu.parallel import distributed as dist
+    try:
+        return dist.process_count() > 1
+    except Exception:  # pragma: no cover - backend not initialized
+        return False
+
+
+def world() -> Tuple[int, int]:
+    """(rank, world size) of this process."""
+    from delphi_tpu.parallel import distributed as dist
+
+    return dist.process_index(), dist.process_count()
+
+
+def active_span(n_rows: int) -> Optional[Tuple[int, int]]:
+    """This rank's contiguous ``[lo, hi)`` row span of an ``n_rows`` table,
+    or ``None`` when sharding is off (disabled, single-process, degraded,
+    or the table is under the row floor). The split is the standard
+    balanced partition — ``lo = r*n//W`` — identical on every rank."""
+    if not shard_enabled():
+        return None
+    n = int(n_rows)
+    if n < shard_min_rows():
+        return None
+    rank, wsize = world()
+    if n < wsize * 4:
+        # degenerate split (a rank could land an empty span); not worth it
+        return None
+    lo = rank * n // wsize
+    hi = (rank + 1) * n // wsize
+    gauge = hi - lo
+    counter_inc("shard.spans")
+    from delphi_tpu.observability import gauge_set
+    gauge_set("shard.rows", gauge)
+    return (lo, hi)
+
+
+def plan_shard_tag() -> Optional[str]:
+    """Rank tag folded into launch-plan signatures and store keys
+    (``r<rank>of<world>``) when the shard plane is live: per-shard plans
+    persist per rank, so a warm rerun replans zero times on EVERY rank;
+    when off (the default) the tag is absent and plan signatures stay
+    byte-identical to the legacy planner."""
+    if not shard_enabled():
+        return None
+    rank, wsize = world()
+    return f"r{rank}of{wsize}"
+
+
+def assign_owners(sizes: Sequence[int]) -> List[int]:
+    """Deterministic greedy LPT owner assignment: items (work groups,
+    entropy pair matrices) sorted by descending size, each assigned to the
+    least-loaded rank, ties broken by index / lowest rank. All ranks
+    derive the identical assignment from the identical replicated
+    sizes."""
+    rank, wsize = world()
+    loads = [0] * wsize
+    owners = [0] * len(sizes)
+    order = sorted(range(len(sizes)), key=lambda i: (-int(sizes[i]), i))
+    for i in order:
+        r = min(range(wsize), key=lambda r: (loads[r], r))
+        owners[i] = r
+        loads[r] += max(int(sizes[i]), 1)
+    return owners
+
+
+def merge_parts(obj, site: str) -> Optional[list]:
+    """All ranks' ``obj`` in rank order (pickled byte-gather through the
+    guarded collective at ``site``), or ``None`` when the gather came back
+    degraded — the caller must then recompute its full range locally
+    (exactly; partial merges are never returned). Counts ``shard.merges``
+    on success, ``shard.degraded`` on the None path."""
+    import pickle
+
+    from delphi_tpu.parallel import distributed as dist
+
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    parts = dist.allgather_bytes_or_none(payload, site)
+    if parts is None:
+        counter_inc("shard.degraded")
+        return None
+    try:
+        out = [pickle.loads(b) for b in parts]
+    except Exception:  # pragma: no cover - corrupt peer payload
+        counter_inc("shard.degraded")
+        return None
+    counter_inc("shard.merges")
+    return out

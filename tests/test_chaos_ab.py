@@ -65,6 +65,15 @@
   once, and a synthetically degraded baseline must trip the
   ``evaluate_slo`` drift gate while the self-baseline passes.
 
+- ``bench.shard_smoke``: the sharded-pipeline A/B — a 2-rank localhost
+  cluster repairs the frame with phase 1-3 analysis row/group-sharded
+  (``DELPHI_SHARD=1``); both ranks' frames must be bit-identical to a
+  1-rank run, every rank records shard merges, the warm rerun loads each
+  rank's persisted per-shard plans (plan-cache hits, zero replans), and
+  a rank killed at its first freq-merge collective degrades rank 0 to
+  the local-recompute path (rank_loss, shard.degraded, single-host
+  latch) with the frame still bit-identical.
+
 All functions print one JSON metric line and return 0 on success; they
 manage (and restore) their own env knobs.
 """
@@ -97,7 +106,8 @@ def _clean_chaos_state():
               "DELPHI_STREAM_MAX_INFLIGHT", "DELPHI_STREAM_KEEP",
               "DELPHI_STREAM_DRIFT_MAX", "DELPHI_TRACE_DIR",
               "DELPHI_TRACE_SAMPLE", "DELPHI_PLAN_DIR",
-              "DELPHI_PLAN_COST")}
+              "DELPHI_PLAN_COST", "DELPHI_SHARD",
+              "DELPHI_SHARD_MIN_ROWS")}
     rz.reset_fault_state()
     rz.clear_abort()
     rz.clear_cpu_fallback()
@@ -152,3 +162,7 @@ def test_stream_chaos_failover_resumes_durable_cursor():
 
 def test_sustained_load_slo_and_autoscale():
     assert bench.load_smoke() == 0
+
+
+def test_shard_parity_warm_plans_and_rank_death():
+    assert bench.shard_smoke() == 0

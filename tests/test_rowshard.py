@@ -1,0 +1,426 @@
+"""Unit tests for the replicated-pipeline shard plane
+(``DELPHI_SHARD``, parallel/rowshard.py) plus lock-in tests for three
+adjacent behaviors (the mesh probe retry-after backoff, the sharded
+outlier-fence approx override warning, and the object-dtype repair row
+ids).
+
+No cluster is spawned: 2-rank topologies are faked by monkeypatching the
+``process_count``/``process_index``/``allgather_host_bytes`` seams in
+distributed.py — the idiom of test_dist_resilience.py. The real 2-process
+cluster coverage (bit-identical frames, warm per-shard plan reuse, rank
+death mid-attr-stats) lives in ``bench.shard_smoke`` via
+test_chaos_ab.py.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from delphi_tpu.parallel import dist_resilience as dr
+from delphi_tpu.parallel import distributed as dist
+from delphi_tpu.parallel import rowshard
+
+
+@pytest.fixture(autouse=True)
+def _clean_shard_state(monkeypatch):
+    monkeypatch.delenv("DELPHI_SHARD", raising=False)
+    monkeypatch.delenv("DELPHI_SHARD_MIN_ROWS", raising=False)
+    dr.reset_dist_state()
+    yield
+    dr.reset_dist_state()
+
+
+def _fake_world(monkeypatch, rank=0, world=2, min_rows="8"):
+    monkeypatch.setenv("DELPHI_SHARD", "1")
+    monkeypatch.setenv("DELPHI_SHARD_MIN_ROWS", min_rows)
+    monkeypatch.setattr(dist, "process_count", lambda: world)
+    monkeypatch.setattr(dist, "process_index", lambda: rank)
+
+
+# -- gating -------------------------------------------------------------------
+
+
+def test_off_by_default_even_on_a_cluster(monkeypatch):
+    """Without DELPHI_SHARD the plane must stay dead on a real multi-
+    process cluster — existing multi-host users see byte-identical
+    behavior."""
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "process_index", lambda: 0)
+    assert not rowshard.shard_enabled()
+    assert rowshard.active_span(1 << 20) is None
+    assert rowshard.plan_shard_tag() is None
+
+
+def test_off_on_a_single_process(monkeypatch):
+    monkeypatch.setenv("DELPHI_SHARD", "1")
+    monkeypatch.setattr(dist, "process_count", lambda: 1)
+    assert not rowshard.shard_enabled()
+
+
+def test_single_host_latch_kills_the_plane(monkeypatch):
+    """After a rank loss the latch must read the plane off — every later
+    phase takes the pure legacy path (the degrade contract)."""
+    _fake_world(monkeypatch)
+    assert rowshard.shard_enabled()
+    dr._state["latched"] = True
+    assert not rowshard.shard_enabled()
+    assert rowshard.active_span(1 << 20) is None
+    assert rowshard.plan_shard_tag() is None
+
+
+# -- span math / owner assignment ---------------------------------------------
+
+
+def test_active_span_partitions_exactly(monkeypatch):
+    for world in (2, 3, 4):
+        spans = []
+        for r in range(world):
+            _fake_world(monkeypatch, rank=r, world=world)
+            spans.append(rowshard.active_span(1001))
+        assert spans[0][0] == 0 and spans[-1][1] == 1001
+        for a, b in zip(spans, spans[1:]):
+            assert a[1] == b[0]  # contiguous, no overlap, no gap
+
+
+def test_active_span_row_floor(monkeypatch):
+    _fake_world(monkeypatch, min_rows="100")
+    assert rowshard.active_span(99) is None
+    assert rowshard.active_span(100) == (0, 50)
+    # degenerate tiny splits refuse even under an explicit floor of 1
+    _fake_world(monkeypatch, world=4, min_rows="1")
+    assert rowshard.active_span(7) is None
+
+
+def test_plan_shard_tag(monkeypatch):
+    _fake_world(monkeypatch, rank=1, world=2)
+    assert rowshard.plan_shard_tag() == "r1of2"
+
+
+def test_assign_owners_balanced_and_rank_independent(monkeypatch):
+    sizes = [100, 1, 90, 5, 80, 7, 3]
+    got = []
+    for r in (0, 1):
+        _fake_world(monkeypatch, rank=r, world=2)
+        got.append(rowshard.assign_owners(sizes))
+    # identical on every rank (it feeds collective alignment), every item
+    # owned, and LPT keeps the load split sane
+    assert got[0] == got[1]
+    owners = got[0]
+    assert set(owners) <= {0, 1}
+    loads = [sum(s for s, o in zip(sizes, owners) if o == r)
+             for r in (0, 1)]
+    assert max(loads) <= 2 * min(loads)
+
+
+# -- merge_parts through the guarded gather seam ------------------------------
+
+
+def test_merge_parts_rank_order_and_site(monkeypatch):
+    _fake_world(monkeypatch)
+    peer = {"x": np.arange(3)}
+    sites = []
+
+    def fake_gather(payload, site="dist.allgather_bytes"):
+        sites.append(site)
+        return [payload, pickle.dumps(peer)]
+
+    monkeypatch.setattr(dist, "allgather_host_bytes", fake_gather)
+    out = rowshard.merge_parts({"x": np.arange(2)}, site="shard.freq.merge")
+    assert sites == ["shard.freq.merge"]
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[0]["x"], np.arange(2))
+    np.testing.assert_array_equal(out[1]["x"], np.arange(3))
+
+
+def test_merge_parts_degraded_gather_returns_none(monkeypatch):
+    """A gather that comes back short (peer declared lost mid-collective)
+    must surface as None — callers recompute their FULL range locally;
+    a silently partial merge would be a wrong answer."""
+    _fake_world(monkeypatch)
+    monkeypatch.setattr(dist, "allgather_host_bytes",
+                        lambda payload, site="dist.allgather_bytes":
+                        [payload])
+    assert rowshard.merge_parts([1, 2], site="shard.detect.merge") is None
+
+
+# -- per-phase merge algebra: faked 2-rank vs the legacy single path ----------
+
+
+def _equiv_frame(n=40):
+    rng = np.random.RandomState(7)
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "c0": rng.choice(["a", "b", "c"], n),
+        "c1": rng.choice(["x", "y", "z", "w"], n),
+        "c2": rng.choice(["p", "q"], n),
+    })
+    df.loc[rng.choice(n, 5, replace=False), "c1"] = None
+    return df
+
+
+def _captured_merge(monkeypatch, captured):
+    """Stub merge_parts: record this rank's local partial and return the
+    degraded None — mimicking a rank loss, including the latch that stops
+    the recursive legacy fallback from re-sharding."""
+    real_world = rowshard.world
+
+    def stub(obj, site):
+        # deep-copy: the degraded path may fill the SAME dict in place
+        captured[(site, real_world()[0])] = pickle.loads(pickle.dumps(obj))
+        os.environ["DELPHI_SHARD"] = "0"
+        return None
+
+    monkeypatch.setattr(rowshard, "merge_parts", stub)
+
+
+def test_sharded_freq_counts_merge_bit_identical(monkeypatch):
+    """Each fake rank's span-local freq counts, merged through the int64
+    sum, must reproduce the legacy full-table FreqStats bit for bit — and
+    the degraded (None) merge must too, via the recursive legacy path."""
+    from delphi_tpu.ops import freq as freq_mod
+    from delphi_tpu.table import encode_table
+
+    table = encode_table(_equiv_frame(), "tid")
+    targets = ["c0", "c1", "c2"]
+    pairs = [("c0", "c1"), ("c1", "c2")]
+    legacy = freq_mod.compute_freq_stats(table, targets, pairs)
+
+    captured = {}
+    parts = []
+    for r in (0, 1):
+        _fake_world(monkeypatch, rank=r, world=2)
+        _captured_merge(monkeypatch, captured)
+        degraded = freq_mod.compute_freq_stats(table, targets, pairs)
+        for a in targets:
+            np.testing.assert_array_equal(degraded.single(a),
+                                          legacy.single(a))
+        parts.append(captured[("shard.freq.merge", r)])
+
+    # now the healthy merge: rank 0 with both ranks' partials gathered
+    _fake_world(monkeypatch, rank=0, world=2)
+    monkeypatch.setattr(rowshard, "merge_parts",
+                        lambda obj, site: list(parts))
+    merged = freq_mod.compute_freq_stats(table, targets, pairs)
+    for a in targets:
+        np.testing.assert_array_equal(merged.single(a), legacy.single(a))
+        assert merged.single(a).dtype == legacy.single(a).dtype
+    for p in pairs:
+        np.testing.assert_array_equal(merged.pair(*p), legacy.pair(*p))
+
+
+def test_sharded_null_detect_merge_bit_identical(monkeypatch):
+    """Rank-ordered concatenation of span-local absolute row indices IS
+    the full ascending scan; the degraded path rescans locally."""
+    from delphi_tpu.ops import detect as detect_mod
+    from delphi_tpu.table import encode_table
+
+    table = encode_table(_equiv_frame(), "tid")
+    targets = ["c0", "c1", "c2"]
+    legacy = detect_mod.detect_null_cells(table, targets)
+
+    def assert_same(got):
+        assert [(a, r.tolist()) for r, a in got] \
+            == [(a, r.tolist()) for r, a in legacy]
+
+    captured = {}
+    parts = []
+    for r in (0, 1):
+        _fake_world(monkeypatch, rank=r, world=2)
+        _captured_merge(monkeypatch, captured)
+        assert_same(detect_mod.detect_null_cells(table, targets))
+        parts.append(captured[("shard.detect.merge", r)])
+
+    _fake_world(monkeypatch, rank=0, world=2)
+    monkeypatch.setattr(rowshard, "merge_parts",
+                        lambda obj, site: list(parts))
+    assert_same(detect_mod.detect_null_cells(table, targets))
+
+
+def test_sharded_entropy_owner_split_bit_identical(monkeypatch):
+    """The greedy owner split computes each H(x,y) on exactly one rank;
+    the gathered scalar dicts must reassemble the legacy result exactly
+    (same float64 reduction per pair, regardless of who ran it)."""
+    from delphi_tpu.ops import entropy as entropy_mod
+    from delphi_tpu.ops import freq as freq_mod
+    from delphi_tpu.table import encode_table
+
+    table = encode_table(_equiv_frame(), "tid")
+    pairs = [("c1", "c0"), ("c1", "c2"), ("c0", "c2")]
+    stats = freq_mod.compute_freq_stats(table, ["c0", "c1", "c2"], pairs)
+    domain_stats = {a: int(stats.vocab_sizes[a]) for a in ("c0", "c1", "c2")}
+    legacy = entropy_mod.compute_pairwise_stats(
+        table.n_rows, stats, pairs, domain_stats)
+
+    captured = {}
+    parts = []
+    for r in (0, 1):
+        _fake_world(monkeypatch, rank=r, world=2)
+        _captured_merge(monkeypatch, captured)
+        degraded = entropy_mod.compute_pairwise_stats(
+            table.n_rows, stats, pairs, domain_stats)
+        assert degraded == legacy
+        parts.append(captured[("shard.entropy.merge", r)])
+
+    # disjoint ownership: each pair index computed on exactly one rank
+    assert set(parts[0]) | set(parts[1]) == {0, 1, 2}
+    assert not set(parts[0]) & set(parts[1])
+
+    _fake_world(monkeypatch, rank=0, world=2)
+    monkeypatch.setattr(rowshard, "merge_parts",
+                        lambda obj, site: list(parts))
+    merged = entropy_mod.compute_pairwise_stats(
+        table.n_rows, stats, pairs, domain_stats)
+    assert merged == legacy
+
+
+def test_distinct_pair_shard_merge_exact(monkeypatch):
+    """Span-deduped fused-key set unions give the EXACT global distinct
+    count (not the max-over-shards lower bound of the process-local
+    path)."""
+    from delphi_tpu.ops import freq as freq_mod
+    from delphi_tpu.table import encode_table
+
+    table = encode_table(_equiv_frame(), "tid")
+    legacy = freq_mod.PairDistinctCounter(table)
+    expect = legacy.distinct_pair_count("c0", "c1")
+
+    for r in (0, 1):
+        _fake_world(monkeypatch, rank=r, world=2)
+        counter = freq_mod.PairDistinctCounter(table)
+        span = rowshard.active_span(table.n_rows)
+        lo, hi = span
+        other = (0, lo) if lo else (hi, table.n_rows)
+        peer_keys = [np.unique(
+            counter._fused_pair_keys("c0", "c1", *other))]
+        monkeypatch.setattr(
+            dist, "allgather_host_bytes",
+            lambda payload, site="dist.allgather_bytes", pk=peer_keys:
+            [payload, pickle.dumps(pk)])
+        assert counter._merge_shard_exact([("c0", "c1")], span) == [expect]
+
+    # degraded gather: None, never a partial union
+    monkeypatch.setattr(dist, "allgather_host_bytes",
+                        lambda payload, site="dist.allgather_bytes":
+                        [payload])
+    counter = freq_mod.PairDistinctCounter(table)
+    assert counter._merge_shard_exact(
+        [("c0", "c1")], rowshard.active_span(table.n_rows)) is None
+
+
+# -- planner: per-shard plan signatures and store keys ------------------------
+
+
+def test_plan_store_keys_carry_the_shard_tag(monkeypatch, tmp_path):
+    """With the plane live, persisted plans key as ``<phase>@r<rank>of<n>``
+    — each rank owns its slot and warm reruns load per-shard plans; with
+    the plane off the key is the bare phase, byte-identical to legacy."""
+    from delphi_tpu.parallel import planner
+
+    monkeypatch.setenv("DELPHI_PLAN_DIR", str(tmp_path))
+    pieces = [planner.Piece(key=i, size=4, shape=(4, 8)) for i in range(3)]
+
+    with planner.plan_fingerprint("fp_shard_test"):
+        planner.plan_launches("tphase", list(pieces))
+        _fake_world(monkeypatch, rank=1, world=2)
+        planner.plan_launches("tphase", list(pieces))
+
+    store = planner.PlanStore(str(tmp_path))
+    phases = set(store._doc("fp_shard_test").get("phases", {}))
+    assert "tphase" in phases
+    assert "tphase@r1of2" in phases
+
+
+# -- lock-ins -----------------------------------------------------------------
+
+
+def test_mesh_probe_retries_after_cooldown(monkeypatch):
+    """A transient backend-probe failure must NOT latch single-device
+    forever: after _PROBE_FAILURE_LIMIT consecutive failures the probe
+    backs off for _PROBE_RETRY_AFTER_S and then tries again (a recovered
+    backend is found); during the cooldown the backend is not touched."""
+    from delphi_tpu.parallel import mesh
+
+    monkeypatch.setenv("DELPHI_MESH", "")
+    monkeypatch.setattr(mesh, "_active_mesh_cache", {})
+    calls = []
+    monkeypatch.setattr(mesh, "_default_mesh",
+                        lambda: (calls.append(1), (None, False))[1])
+
+    for _ in range(mesh._PROBE_FAILURE_LIMIT):
+        assert mesh.get_active_mesh() is None
+    assert len(calls) == mesh._PROBE_FAILURE_LIMIT
+    assert "__probe_retry_at__" in mesh._active_mesh_cache
+
+    # inside the cooldown: answered single-device WITHOUT re-probing
+    assert mesh.get_active_mesh() is None
+    assert len(calls) == mesh._PROBE_FAILURE_LIMIT
+
+    # cooldown elapsed: the probe runs again, and a recovered backend
+    # clears the failure bookkeeping
+    mesh._active_mesh_cache["__probe_retry_at__"] = 0.0
+    monkeypatch.setattr(mesh, "_default_mesh", lambda: (None, True))
+    assert mesh.get_active_mesh() is None  # None mesh, but CACHEABLE now
+    assert "__probe_retry_at__" not in mesh._active_mesh_cache
+    assert "__probe_failures__" not in mesh._active_mesh_cache
+    assert "__default__" in mesh._active_mesh_cache
+
+
+def test_outlier_approx_override_warns(monkeypatch, caplog):
+    """approx_enabled=False on a process-local table is OVERRIDDEN (the
+    sharded fence pool is row-weighted-sampled by design); that override
+    must surface at WARNING, not vanish at info level."""
+    import dataclasses
+    import logging
+
+    from delphi_tpu.ops import detect as detect_mod
+    from delphi_tpu.table import encode_table
+
+    n = 50
+    df = pd.DataFrame({
+        "tid": np.arange(n).astype(str),
+        "val": np.linspace(0.0, 1.0, n),
+    })
+    table = encode_table(df, "tid")
+    table = dataclasses.replace(table, process_local=True)
+    monkeypatch.setattr(detect_mod, "APPROX_PERCENTILE_SAMPLE", 10)
+
+    with caplog.at_level(logging.WARNING,
+                         logger=detect_mod._logger.name):
+        detect_mod.detect_outliers(table, ["val"], ["val"], approx=False)
+    assert any("approx_enabled=False overridden" in r.message
+               and r.levelno == logging.WARNING for r in caplog.records)
+
+
+def test_repair_row_ids_stay_python_scalars():
+    """Integer-keyed tables must come back with object-dtype row ids
+    (plain Python scalars) in the repair frame — numpy-int64 keys break
+    callers that compare against the original frame's values (the
+    reference's SQL flatten kept plain values)."""
+    from delphi_tpu import NullErrorDetector, delphi
+    from delphi_tpu.session import get_session
+
+    n = 48
+    df = pd.DataFrame({
+        "tid": np.arange(n),  # int64 row ids, NOT strings
+        "c0": ["a" if i % 2 else "b" for i in range(n)],
+        "c1": [str(i % 4) for i in range(n)],
+        "c2": [str((i * 7) % 5) for i in range(n)],
+    })
+    df.loc[df.index % 11 == 0, "c1"] = None
+
+    get_session().register("rid_dtype_test", df)
+    try:
+        out = delphi.repair \
+            .setTableName("rid_dtype_test") \
+            .setRowId("tid") \
+            .setErrorDetectors([NullErrorDetector()]) \
+            .run()
+    finally:
+        get_session().drop("rid_dtype_test")
+
+    assert len(out) > 0
+    assert out["tid"].dtype == object
+    assert all(not isinstance(v, np.integer) for v in out["tid"])
